@@ -1,0 +1,31 @@
+"""learningorchestra_tpu — a TPU-native distributed data-science framework.
+
+A ground-up reimplementation of the capabilities of
+hiperbolt/learningOrchestra (see /root/reference): CSV dataset ingestion
+into a document store, column projection, field type conversion, value
+histograms, PCA / t-SNE image plots, and a multi-classifier model builder
+(logistic regression, decision tree, random forest, gradient-boosted
+trees, naive bayes) with user-supplied preprocessing — exposed through
+the same REST microservice APIs and Python client.
+
+Where the reference delegates distributed compute to an Apache Spark
+cluster (reference: microservices/spark_image/Dockerfile:1-37) and
+storage to a MongoDB replica set (reference: docker-compose.yml:27-91),
+this framework is JAX/XLA-first:
+
+- datasets are columnar tables sharded over a ``jax.sharding.Mesh``
+  (``parallel/``), with ``jax.lax`` collectives over ICI in place of RDD
+  shuffles;
+- the classifiers and decompositions are JAX programs that keep the
+  FLOPs on the MXU (``models/``, ``ops/``);
+- storage is a built-in document store with the same
+  collection-of-documents + metadata-row contract (``core/store.py``);
+- the REST layer (``services/``) and Python client (``client.py``)
+  reproduce the reference's routes, ports, status codes and error
+  strings so existing callers keep working.
+
+Subpackages appear as they land; consult the repo README for current
+status.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Core: document store, columnar tables, job management, CSV ingestion."""
+
+from learningorchestra_tpu.core.store import (  # noqa: F401
+    METADATA_ID,
+    ROW_ID,
+    DocumentStore,
+    InMemoryStore,
+    global_store,
+)

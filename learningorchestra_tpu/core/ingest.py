@@ -1,0 +1,137 @@
+"""CSV dataset ingestion: URL → document store.
+
+Reference behaviour (microservices/database_api_image/database.py:134-216):
+a 3-thread pipeline (download → row-to-dict → per-row ``insert_one``)
+guarded by a first-line sniff that rejects HTML/JSON bodies; metadata is
+written up front with ``finished: false`` and flipped when the save thread
+drains. Values are stored as raw strings — type conversion is a separate
+service.
+
+This implementation keeps the observable contract (metadata shape,
+``finished`` flag, string values, 201-then-poll asynchrony) but streams
+into *batched* ``insert_many`` calls instead of one RPC per row, and
+supports ``file://``/local paths so tests need no network.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+from contextlib import ExitStack, closing
+from datetime import datetime, timezone
+from typing import Iterator, TextIO
+
+import requests
+
+from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore
+
+INVALID_URL = "invalid_url"
+DUPLICATE_FILE = "duplicate_file"
+FINISHED = "finished"
+BATCH_SIZE = 4096
+
+
+class IngestError(Exception):
+    pass
+
+
+def _open_text(url: str, stack: ExitStack) -> TextIO:
+    """A text stream over an http(s) URL, file:// URL or local path.
+
+    Returns a real character stream (not pre-split lines) so the csv
+    parser sees quoted embedded newlines intact.
+    """
+    if url.startswith(("http://", "https://")):
+        response = stack.enter_context(closing(requests.get(url, stream=True)))
+        response.raise_for_status()
+        response.raw.decode_content = True
+        return stack.enter_context(
+            io.TextIOWrapper(response.raw, encoding="utf-8", newline="")
+        )
+    path = url[len("file://") :] if url.startswith("file://") else url
+    return stack.enter_context(open(path, encoding="utf-8", newline=""))
+
+
+def _csv_rows(stream: TextIO) -> Iterator[list[str]]:
+    return iter(csv.reader(stream, delimiter=",", quotechar='"'))
+
+
+def validate_csv_url(url: str) -> list[str]:
+    """Sniff the header row; reject HTML/JSON bodies.
+
+    Mirrors the reference's first-character check (reference:
+    database.py:183-197). Returns the header row.
+    """
+    try:
+        with ExitStack() as stack:
+            header = next(_csv_rows(_open_text(url, stack)))
+    except (OSError, requests.exceptions.RequestException, StopIteration) as error:
+        raise IngestError(INVALID_URL) from error
+    if not header or not header[0] or header[0][0] in ("<", "{"):
+        raise IngestError(INVALID_URL)
+    return header
+
+
+def timestamp() -> str:
+    """UTC timestamp in the reference's metadata format (reference:
+    database.py:201-204)."""
+    return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S-00:00")
+
+
+def write_ingest_metadata(store: DocumentStore, filename: str, url: str) -> None:
+    """The up-front ``finished: false`` metadata document (reference:
+    database.py:205-213). Raises on duplicate collection."""
+    store.insert_one(
+        filename,
+        {
+            "filename": filename,
+            "url": url,
+            "time_created": timestamp(),
+            ROW_ID: METADATA_ID,
+            FINISHED: False,
+            "fields": "processing",
+        },
+    )
+
+
+def ingest_csv(
+    store: DocumentStore,
+    filename: str,
+    url: str,
+    batch_size: int = BATCH_SIZE,
+) -> int:
+    """Stream the CSV at ``url`` into collection ``filename``.
+
+    Rows become documents ``{header[i]: value, _id: 1..N}`` with values
+    kept as strings (type conversion is the fieldtypes service's job).
+    Flips the metadata to ``finished: true`` with the field list when the
+    stream drains. Returns the row count.
+    """
+    with ExitStack() as stack:
+        reader = _csv_rows(_open_text(url, stack))
+        file_header = next(reader)
+
+        batch: list[dict] = []
+        row_id = 0
+        width = len(file_header)
+        for row in reader:
+            if not row:
+                continue
+            row_id += 1
+            document = {
+                file_header[i]: (row[i] if i < len(row) else "") for i in range(width)
+            }
+            document[ROW_ID] = row_id
+            batch.append(document)
+            if len(batch) >= batch_size:
+                store.insert_many(filename, batch)
+                batch = []
+        if batch:
+            store.insert_many(filename, batch)
+
+    store.update_one(
+        filename,
+        {ROW_ID: METADATA_ID},
+        {FINISHED: True, "fields": file_header},
+    )
+    return row_id

@@ -1,0 +1,131 @@
+"""Asynchronous job management with explicit states.
+
+The reference's only job abstraction is the ``finished`` boolean on a
+dataset's metadata document: a service writes ``finished: false``, does
+work on daemon threads, and flips it to ``true``; a crashed job leaves
+``finished: false`` forever and clients poll indefinitely (reference:
+microservices/database_api_image/database.py:199-216,
+learning_orchestra_client/__init__.py:24-32).
+
+This JobManager keeps that wire contract (so unchanged clients still
+poll ``finished``) but adds real states — PENDING/RUNNING/FINISHED/
+FAILED with an error payload and timings — and, on failure, *still*
+flips ``finished`` on the tracked dataset so pollers terminate, while
+recording the error in the metadata document.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore
+
+PENDING = "pending"
+RUNNING = "running"
+FINISHED = "finished"
+FAILED = "failed"
+
+
+@dataclass
+class JobRecord:
+    name: str
+    state: str = PENDING
+    error: Optional[str] = None
+    submitted_at: float = field(default_factory=time.time)
+    started_at: Optional[float] = None
+    ended_at: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "state": self.state,
+            "error": self.error,
+            "submitted_at": self.submitted_at,
+            "started_at": self.started_at,
+            "ended_at": self.ended_at,
+        }
+
+
+class JobManager:
+    def __init__(self, max_workers: int = 8):
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._jobs: dict[str, JobRecord] = {}
+        self._lock = threading.Lock()
+        self._events: dict[str, threading.Event] = {}
+
+    def submit(
+        self,
+        name: str,
+        fn: Callable,
+        *args,
+        store: Optional[DocumentStore] = None,
+        collection: Optional[str] = None,
+        **kwargs,
+    ) -> JobRecord:
+        """Run ``fn`` on the pool. If ``store``/``collection`` are given,
+        a failure marks that dataset's metadata ``finished: true`` with an
+        ``error`` field so pollers terminate instead of hanging."""
+        record = JobRecord(name=name)
+        with self._lock:
+            existing = self._jobs.get(name)
+            if existing is not None and existing.state in (PENDING, RUNNING):
+                raise ValueError(f"job {name!r} is already {existing.state}")
+            self._jobs[name] = record
+            done = threading.Event()
+            self._events[name] = done
+
+        def run():
+            record.state = RUNNING
+            record.started_at = time.time()
+            try:
+                fn(*args, **kwargs)
+                record.state = FINISHED
+            except Exception as error:
+                record.state = FAILED
+                record.error = f"{type(error).__name__}: {error}"
+                traceback.print_exc()
+                if store is not None and collection is not None:
+                    store.update_one(
+                        collection,
+                        {ROW_ID: METADATA_ID},
+                        {"finished": True, "error": record.error},
+                    )
+            finally:
+                record.ended_at = time.time()
+                done.set()
+
+        self._pool.submit(run)
+        return record
+
+    def get(self, name: str) -> Optional[JobRecord]:
+        with self._lock:
+            return self._jobs.get(name)
+
+    def wait(self, name: str, timeout: Optional[float] = None) -> JobRecord:
+        event = self._events.get(name)
+        if event is None:
+            raise KeyError(f"unknown job {name!r}")
+        if not event.wait(timeout):
+            raise TimeoutError(f"job {name!r} still {self._jobs[name].state}")
+        return self._jobs[name]
+
+    def all_jobs(self) -> list[dict]:
+        with self._lock:
+            return [record.as_dict() for record in self._jobs.values()]
+
+
+_MANAGER: Optional[JobManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def global_job_manager() -> JobManager:
+    global _MANAGER
+    with _MANAGER_LOCK:
+        if _MANAGER is None:
+            _MANAGER = JobManager()
+        return _MANAGER

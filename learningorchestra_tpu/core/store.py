@@ -1,0 +1,348 @@
+"""Document store: the framework's storage contract.
+
+The reference uses a MongoDB replica set as its only data plane; every
+dataset is a collection whose row ``_id: 0`` is a metadata document with a
+``finished`` flag, and rows are documents ``_id: 1..N`` (reference:
+microservices/database_api_image/database.py:14-15,199-216). This module
+keeps that contract but makes the store a first-class, pluggable part of
+the framework:
+
+- :class:`DocumentStore` — the interface every backend implements. It is
+  a superset of the hand-rolled ``DatabaseInterface`` ABCs scattered
+  through the reference services (e.g. reference:
+  microservices/model_builder_image/model_builder.py:33-43).
+- :class:`InMemoryStore` — thread-safe in-process backend with an
+  optional JSONL write-ahead log for durability. Used directly by tests
+  and by the storage service (``services/storage.py``).
+- Columnar reads (:meth:`DocumentStore.read_columns`) are the data plane
+  between storage and the TPU: compute never does row-at-a-time RPCs the
+  way the reference does (reference:
+  microservices/model_builder_image/model_builder.py:237-247).
+
+Queries are Mongo-style subset-equality matches, which is the full extent
+of what the reference services use.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import threading
+from typing import Any, Iterator, Optional
+
+ROW_ID = "_id"
+METADATA_ID = 0
+
+# Metadata keys a dataset's `_id: 0` document may carry (reference:
+# microservices/model_builder_image/model_builder.py:103-111).
+METADATA_FIELDS = (
+    "_id",
+    "fields",
+    "filename",
+    "finished",
+    "time_created",
+    "url",
+    "parent_filename",
+)
+
+
+def parse_query(raw: Optional[str]) -> dict:
+    """Parse a query string sent over REST.
+
+    The reference client serialises queries with ``str(dict)`` (reference:
+    learning_orchestra_client/__init__.py:75) which produces Python repr,
+    while the server parses with ``json.loads`` (reference:
+    microservices/database_api_image/database.py:40) — so any non-trivial
+    query crashes it. We accept both encodings.
+    """
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except (json.JSONDecodeError, ValueError):
+        return ast.literal_eval(raw)
+
+
+def matches(document: dict, query: dict) -> bool:
+    """Mongo-style subset equality: every query pair must match."""
+    for key, value in query.items():
+        if key not in document or document[key] != value:
+            return False
+    return True
+
+
+class DocumentStore:
+    """Interface for collection-of-documents backends."""
+
+    # --- collection lifecycle -------------------------------------------------
+    def list_collections(self) -> list[str]:
+        raise NotImplementedError
+
+    def drop(self, collection: str) -> None:
+        raise NotImplementedError
+
+    # --- writes ---------------------------------------------------------------
+    def insert_one(self, collection: str, document: dict) -> None:
+        raise NotImplementedError
+
+    def insert_many(self, collection: str, documents: list[dict]) -> None:
+        for document in documents:
+            self.insert_one(collection, document)
+
+    def update_one(self, collection: str, query: dict, new_values: dict) -> None:
+        """Set ``new_values`` on the first document matching ``query``
+        (Mongo ``update_one(filter, {"$set": ...})`` semantics)."""
+        raise NotImplementedError
+
+    # --- reads ----------------------------------------------------------------
+    def find(
+        self,
+        collection: str,
+        query: Optional[dict] = None,
+        skip: int = 0,
+        limit: Optional[int] = None,
+    ) -> Iterator[dict]:
+        """Documents matching ``query``, ordered by ``_id`` ascending."""
+        raise NotImplementedError
+
+    def find_one(self, collection: str, query: dict) -> Optional[dict]:
+        for document in self.find(collection, query, limit=1):
+            return document
+        return None
+
+    def count(self, collection: str) -> int:
+        return sum(1 for _ in self.find(collection))
+
+    def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
+        """The ``$group``/``$sum: 1`` value-count pipeline the histogram
+        service pushes down (reference:
+        microservices/histogram_image/histogram.py:63-69)."""
+        raise NotImplementedError
+
+    # --- columnar data plane --------------------------------------------------
+    def read_columns(
+        self, collection: str, fields: Optional[list[str]] = None
+    ) -> dict[str, list]:
+        """Column-major read of all non-metadata rows, ordered by ``_id``.
+
+        Returns ``{field: [values...]}``. This is the storage→device path:
+        one bulk call instead of the reference's per-row RPCs.
+        """
+        rows = [
+            document
+            for document in self.find(collection)
+            if document.get(ROW_ID) != METADATA_ID
+        ]
+        if fields is None:
+            names: list[str] = []
+            for row in rows:
+                for key in row:
+                    if key not in names and key != ROW_ID:
+                        names.append(key)
+            fields = names
+        return {
+            field: [row.get(field) for row in rows] for field in fields
+        }
+
+    # --- dataset metadata contract -------------------------------------------
+    def metadata(self, collection: str) -> Optional[dict]:
+        return self.find_one(collection, {ROW_ID: METADATA_ID})
+
+    def is_finished(self, collection: str) -> bool:
+        meta = self.metadata(collection)
+        return bool(meta and meta.get("finished"))
+
+
+def _group_count(documents: list[dict], field: str) -> list[dict]:
+    counts: dict[Any, int] = {}
+    for document in documents:
+        if document.get(ROW_ID) == METADATA_ID:
+            continue
+        key = document.get(field)
+        counts[key] = counts.get(key, 0) + 1
+    return [{"_id": key, "count": count} for key, count in counts.items()]
+
+
+class InMemoryStore(DocumentStore):
+    """Thread-safe in-process store with optional JSONL write-ahead log.
+
+    Durability model: every mutation appends one JSON line to
+    ``<data_dir>/wal.jsonl``; opening a store with the same ``data_dir``
+    replays the log. ``compact()`` rewrites the log as a snapshot.
+    """
+
+    def __init__(self, data_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._collections: dict[str, dict[Any, dict]] = {}
+        self._wal = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            wal_path = os.path.join(data_dir, "wal.jsonl")
+            if os.path.exists(wal_path):
+                self._replay(wal_path)
+            self._wal = open(wal_path, "a", encoding="utf-8")
+
+    # --- WAL ------------------------------------------------------------------
+    def _log(self, record: dict) -> None:
+        if self._wal is not None:
+            self._wal.write(json.dumps(record) + "\n")
+            self._wal.flush()
+
+    def _replay(self, wal_path: str) -> None:
+        with open(wal_path, encoding="utf-8") as handle:
+            for line in handle:
+                line = line.strip()
+                if not line:
+                    continue
+                record = json.loads(line)
+                op = record["op"]
+                if op == "insert":
+                    self._apply_insert(record["c"], record["d"])
+                elif op == "insert_many":
+                    for document in record["d"]:
+                        self._apply_insert(record["c"], document)
+                elif op == "update":
+                    self._apply_update(record["c"], record["q"], record["v"])
+                elif op == "drop":
+                    self._collections.pop(record["c"], None)
+
+    def compact(self) -> None:
+        with self._lock:
+            if self._wal is None:
+                return
+            path = self._wal.name
+            self._wal.close()
+            with open(path, "w", encoding="utf-8") as handle:
+                for name, documents in self._collections.items():
+                    handle.write(
+                        json.dumps(
+                            {"op": "insert_many", "c": name, "d": list(documents.values())}
+                        )
+                        + "\n"
+                    )
+            self._wal = open(path, "a", encoding="utf-8")
+
+    # --- primitive ops (no locking/logging) -----------------------------------
+    def _apply_insert(self, collection: str, document: dict) -> None:
+        bucket = self._collections.setdefault(collection, {})
+        doc_id = document.get(ROW_ID)
+        if doc_id is None:
+            doc_id = (max((k for k in bucket if isinstance(k, int)), default=0) + 1)
+            document = dict(document)
+            document[ROW_ID] = doc_id
+        if doc_id in bucket:
+            raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
+        bucket[doc_id] = dict(document)
+
+    def _apply_update(self, collection: str, query: dict, new_values: dict) -> None:
+        bucket = self._collections.get(collection, {})
+        for document in bucket.values():
+            if matches(document, query):
+                document.update(new_values)
+                return
+
+    # --- DocumentStore implementation -----------------------------------------
+    def list_collections(self) -> list[str]:
+        with self._lock:
+            return list(self._collections.keys())
+
+    def drop(self, collection: str) -> None:
+        with self._lock:
+            self._collections.pop(collection, None)
+            self._log({"op": "drop", "c": collection})
+
+    def insert_one(self, collection: str, document: dict) -> None:
+        with self._lock:
+            self._apply_insert(collection, document)
+            self._log({"op": "insert", "c": collection, "d": document})
+
+    def insert_many(self, collection: str, documents: list[dict]) -> None:
+        with self._lock:
+            # Validate the whole batch before applying anything so a
+            # duplicate-_id failure can't leave the in-memory state and
+            # the WAL divergent (all-or-nothing).
+            bucket = self._collections.get(collection, {})
+            seen: set = set()
+            for document in documents:
+                doc_id = document.get(ROW_ID)
+                if doc_id is None:
+                    continue  # auto-assigned at apply time, cannot collide
+                if doc_id in bucket or doc_id in seen:
+                    raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
+                seen.add(doc_id)
+            for document in documents:
+                self._apply_insert(collection, document)
+            self._log({"op": "insert_many", "c": collection, "d": documents})
+
+    def update_one(self, collection: str, query: dict, new_values: dict) -> None:
+        with self._lock:
+            self._apply_update(collection, query, new_values)
+            self._log({"op": "update", "c": collection, "q": query, "v": new_values})
+
+    def find(
+        self,
+        collection: str,
+        query: Optional[dict] = None,
+        skip: int = 0,
+        limit: Optional[int] = None,
+    ) -> Iterator[dict]:
+        with self._lock:
+            bucket = self._collections.get(collection, {})
+            ordered = sorted(
+                bucket.values(),
+                key=lambda doc: (not isinstance(doc.get(ROW_ID), int), doc.get(ROW_ID)),
+            )
+        query = query or {}
+        produced = 0
+        skipped = 0
+        for document in ordered:
+            if not matches(document, query):
+                continue
+            if skipped < skip:
+                skipped += 1
+                continue
+            if limit is not None and produced >= limit:
+                break
+            produced += 1
+            yield dict(document)
+
+    def count(self, collection: str) -> int:
+        with self._lock:
+            return len(self._collections.get(collection, {}))
+
+    def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
+        with self._lock:
+            documents = list(self._collections.get(collection, {}).values())
+        results: list[dict] = [dict(document) for document in documents]
+        for stage in pipeline:
+            if "$match" in stage:
+                results = [doc for doc in results if matches(doc, stage["$match"])]
+            elif "$group" in stage:
+                group = stage["$group"]
+                key_expr = group.get("_id")
+                if not (isinstance(key_expr, str) and key_expr.startswith("$")):
+                    raise NotImplementedError(f"unsupported $group key {key_expr!r}")
+                results = _group_count(results, key_expr[1:])
+            else:
+                raise NotImplementedError(f"unsupported pipeline stage {stage}")
+        return results
+
+
+_GLOBAL_STORE: Optional[InMemoryStore] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_store() -> InMemoryStore:
+    """Process-wide shared store (single-process deployments and tests)."""
+    global _GLOBAL_STORE
+    with _GLOBAL_LOCK:
+        if _GLOBAL_STORE is None:
+            _GLOBAL_STORE = InMemoryStore()
+        return _GLOBAL_STORE
+
+
+def reset_global_store() -> None:
+    global _GLOBAL_STORE
+    with _GLOBAL_LOCK:
+        _GLOBAL_STORE = None

@@ -1,0 +1,22 @@
+"""Parallelism: device meshes, shardings, distributed runtime.
+
+This package replaces the reference's Spark standalone cluster
+(reference: microservices/spark_image/, docker-compose.yml:123-163): rows
+of a dataset are sharded over the ``data`` axis of a
+``jax.sharding.Mesh`` the way Spark partitions RDDs over workers, and
+cross-device reductions ride XLA collectives over ICI instead of RDD
+shuffles.
+"""
+
+from learningorchestra_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    default_mesh,
+    make_mesh,
+)
+from learningorchestra_tpu.parallel.sharding import (  # noqa: F401
+    pad_rows,
+    replicated,
+    row_sharded,
+    shard_rows,
+)

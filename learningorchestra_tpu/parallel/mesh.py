@@ -1,0 +1,66 @@
+"""Device mesh construction.
+
+The framework's standard mesh has two axes:
+
+- ``data`` — batch/row parallelism (the Spark-worker analogue; scaling
+  this axis is the equivalent of ``docker service scale
+  microservice_sparkworker=N`` in the reference, README.md:94);
+- ``model`` — feature/class/tree parallelism for estimators whose inner
+  dimension is worth sharding (tensor-parallel axis).
+
+Single-chip runs get a 1×1 mesh and the same code path: everything is
+written mesh-relative so multi-chip is a deployment knob, not a code
+change.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over ``devices``.
+
+    ``data=None`` takes every remaining device after ``model`` is carved
+    out. Device order follows ``jax.devices()`` so the data axis maps to
+    contiguous ICI neighbours on a TPU slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if model < 1:
+        raise ValueError(f"model axis must be >= 1, got {model}")
+    if data is None:
+        if len(devices) % model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by model={model}"
+            )
+        data = len(devices) // model
+    if data * model > len(devices):
+        raise ValueError(
+            f"mesh {data}x{model} needs {data * model} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[: data * model]).reshape(data, model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def default_mesh() -> Mesh:
+    """All visible devices on the ``data`` axis."""
+    return make_mesh()
+
+
+def data_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]
+
+
+def model_size(mesh: Mesh) -> int:
+    return mesh.shape[MODEL_AXIS]

@@ -1,0 +1,67 @@
+"""Sharding helpers: rows over the ``data`` axis, replication, padding.
+
+Replaces the reference's RDD partitioning (reference:
+microservices/projection_image/projection.py:104-111 reads a Mongo
+collection as Spark partitions). A table's row dimension is sharded over
+the mesh's ``data`` axis with ``jax.device_put``; XLA then inserts ICI
+collectives for any cross-shard reduction instead of a shuffle.
+
+TPU note: row counts are padded to a multiple of the data-axis size
+(static shapes — XLA compiles one program per padded shape, and
+estimators carry an explicit validity mask rather than using dynamic
+shapes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from learningorchestra_tpu.parallel.mesh import DATA_AXIS
+
+
+def pad_rows(array: np.ndarray, multiple: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pad axis 0 to a multiple; returns (padded, validity mask)."""
+    n = array.shape[0]
+    padded_n = ((n + multiple - 1) // multiple) * multiple
+    mask = np.zeros(padded_n, dtype=bool)
+    mask[:n] = True
+    if padded_n == n:
+        return array, mask
+    pad_width = [(0, padded_n - n)] + [(0, 0)] * (array.ndim - 1)
+    return np.pad(array, pad_width), mask
+
+
+def row_sharded(mesh: Mesh) -> NamedSharding:
+    """Rows over ``data``, everything else replicated."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_rows(
+    array: np.ndarray, mesh: Mesh, dtype=None
+) -> tuple[jax.Array, jax.Array]:
+    """Pad + device_put an array row-sharded over the mesh.
+
+    Returns ``(device_array, device_mask)`` where the boolean mask marks
+    real (non-padding) rows; both are sharded identically so masked
+    reductions stay local until the final psum.
+    """
+    n_shards = mesh.shape[DATA_AXIS]
+    padded, mask = pad_rows(np.asarray(array), n_shards)
+    if dtype is not None:
+        padded = padded.astype(dtype)
+    sharding = row_sharded(mesh)
+    return (
+        jax.device_put(padded, sharding),
+        jax.device_put(mask, sharding),
+    )
+
+
+def put_replicated(value, mesh: Mesh) -> jax.Array:
+    return jax.device_put(jnp.asarray(value), replicated(mesh))

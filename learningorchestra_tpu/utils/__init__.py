@@ -1,0 +1,1 @@
+"""Shared utilities: the micro web framework, env/config handling."""

@@ -1,0 +1,128 @@
+"""Minimal WSGI micro-framework over werkzeug.
+
+The reference exposes its services as Flask apps (e.g. reference:
+microservices/database_api_image/server.py:31). Flask is not available in
+this environment, so this module provides the thin slice of that surface
+our services need — routing with URL parameters, JSON request/response
+helpers, file responses, a test client, and a threaded dev server — on
+top of werkzeug, which is available.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Callable
+
+from werkzeug.exceptions import HTTPException, NotFound
+from werkzeug.routing import Map, Rule
+from werkzeug.serving import make_server
+from werkzeug.test import Client
+from werkzeug.wrappers import Request, Response
+
+
+def jsonify(payload: Any) -> Response:
+    return Response(
+        json.dumps(payload), mimetype="application/json", status=200
+    )
+
+
+def send_file(path: str, mimetype: str) -> Response:
+    with open(path, "rb") as handle:
+        data = handle.read()
+    return Response(data, mimetype=mimetype, status=200)
+
+
+class WebApp:
+    """A WSGI application with Flask-like ``route`` registration.
+
+    Handlers receive the ``werkzeug`` ``Request`` as their first argument
+    (instead of Flask's implicit request global) plus any URL parameters,
+    and may return a ``Response``, or a ``(payload, status)`` tuple where
+    the payload is JSON-serialised.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.url_map = Map()
+        self._handlers: dict[str, Callable] = {}
+
+    def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
+        def decorator(handler: Callable) -> Callable:
+            endpoint = f"{handler.__name__}|{rule}|{'|'.join(methods)}"
+            self.url_map.add(Rule(rule, endpoint=endpoint, methods=list(methods)))
+            self._handlers[endpoint] = handler
+            return handler
+
+        return decorator
+
+    def _dispatch(self, request: Request) -> Response:
+        adapter = self.url_map.bind_to_environ(request.environ)
+        try:
+            endpoint, args = adapter.match()
+        except NotFound:
+            return Response(
+                json.dumps({"result": "not_found"}),
+                mimetype="application/json",
+                status=404,
+            )
+        except HTTPException as error:
+            return error.get_response(request.environ)
+
+        try:
+            result = self._handlers[endpoint](request, **args)
+        except HTTPException as error:
+            # e.g. BadRequest from request.get_json() on a malformed
+            # body — keep its real status code, don't convert to a 500.
+            return error.get_response(request.environ)
+        if isinstance(result, Response):
+            return result
+        if isinstance(result, tuple):
+            payload, status = result
+            if isinstance(payload, Response):
+                payload.status_code = status
+                return payload
+            return Response(
+                json.dumps(payload), mimetype="application/json", status=status
+            )
+        return Response(
+            json.dumps(result), mimetype="application/json", status=200
+        )
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            response = self._dispatch(request)
+        except Exception as error:  # mirror Flask's 500-with-traceback text
+            response = Response(
+                f"{type(error).__name__}: {error}", status=500, mimetype="text/plain"
+            )
+        return response(environ, start_response)
+
+    def test_client(self) -> Client:
+        return Client(self, Response)
+
+
+class ServerThread:
+    """Run a WSGI app on a background thread (integration tests, dev)."""
+
+    def __init__(self, app: WebApp, host: str, port: int):
+        self._server = make_server(host, port, app, threaded=True)
+        self.host = host
+        self.port = self._server.server_port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name=f"{app.name}-server"
+        )
+
+    def start(self) -> "ServerThread":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._thread.join(timeout=5)
+
+
+def run_app(app: WebApp, host: str, port: int) -> None:
+    """Serve forever in the foreground (container entrypoint)."""
+    make_server(host, port, app, threaded=True).serve_forever()

@@ -1,0 +1,149 @@
+"""Core store: Mongo-contract semantics, WAL durability, aggregation."""
+
+import threading
+
+import pytest
+
+from learningorchestra_tpu.core.store import (
+    METADATA_ID,
+    ROW_ID,
+    InMemoryStore,
+    matches,
+    parse_query,
+)
+
+
+def test_insert_find_ordering(store):
+    store.insert_one("ds", {ROW_ID: METADATA_ID, "filename": "ds", "finished": False})
+    store.insert_many("ds", [{ROW_ID: i, "x": i * 10} for i in range(1, 6)])
+    docs = list(store.find("ds"))
+    assert [d[ROW_ID] for d in docs] == [0, 1, 2, 3, 4, 5]
+
+
+def test_skip_limit_pagination(store):
+    store.insert_many("ds", [{ROW_ID: i, "x": i} for i in range(10)])
+    docs = list(store.find("ds", skip=3, limit=4))
+    assert [d[ROW_ID] for d in docs] == [3, 4, 5, 6]
+
+
+def test_query_subset_match(store):
+    store.insert_many(
+        "ds",
+        [
+            {ROW_ID: 1, "a": "x", "b": 1},
+            {ROW_ID: 2, "a": "y", "b": 1},
+            {ROW_ID: 3, "a": "x", "b": 2},
+        ],
+    )
+    assert [d[ROW_ID] for d in store.find("ds", {"a": "x"})] == [1, 3]
+    assert store.find_one("ds", {"a": "y"})[ROW_ID] == 2
+    assert store.find_one("ds", {"a": "zzz"}) is None
+
+
+def test_update_one_sets_fields(store):
+    store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": False})
+    store.update_one("ds", {ROW_ID: METADATA_ID}, {"finished": True, "fields": ["a"]})
+    meta = store.metadata("ds")
+    assert meta["finished"] is True and meta["fields"] == ["a"]
+    assert store.is_finished("ds")
+
+
+def test_duplicate_id_rejected(store):
+    store.insert_one("ds", {ROW_ID: 1})
+    with pytest.raises(KeyError):
+        store.insert_one("ds", {ROW_ID: 1})
+
+
+def test_drop_and_list(store):
+    store.insert_one("a", {ROW_ID: 1})
+    store.insert_one("b", {ROW_ID: 1})
+    assert sorted(store.list_collections()) == ["a", "b"]
+    store.drop("a")
+    assert store.list_collections() == ["b"]
+
+
+def test_aggregate_group_count(store):
+    # The histogram service's $group pushdown (reference: histogram.py:63-69).
+    store.insert_one("ds", {ROW_ID: METADATA_ID, "filename": "ds"})
+    store.insert_many(
+        "ds", [{ROW_ID: i, "sex": "m" if i % 3 else "f"} for i in range(1, 10)]
+    )
+    result = store.aggregate(
+        "ds", [{"$group": {"_id": "$sex", "count": {"$sum": 1}}}]
+    )
+    counts = {row["_id"]: row["count"] for row in result}
+    assert counts == {"m": 6, "f": 3}
+
+
+def test_read_columns_excludes_metadata(store):
+    store.insert_one("ds", {ROW_ID: METADATA_ID, "filename": "ds", "fields": ["x"]})
+    store.insert_many("ds", [{ROW_ID: i, "x": i, "y": str(i)} for i in range(1, 4)])
+    cols = store.read_columns("ds")
+    assert cols["x"] == [1, 2, 3]
+    assert cols["y"] == ["1", "2", "3"]
+
+
+def test_wal_replay_roundtrip(tmp_path):
+    data_dir = str(tmp_path / "wal")
+    first = InMemoryStore(data_dir=data_dir)
+    first.insert_one("ds", {ROW_ID: 0, "finished": False})
+    first.insert_many("ds", [{ROW_ID: 1, "x": 1}, {ROW_ID: 2, "x": 2}])
+    first.update_one("ds", {ROW_ID: 0}, {"finished": True})
+    first.insert_one("gone", {ROW_ID: 1})
+    first.drop("gone")
+
+    reopened = InMemoryStore(data_dir=data_dir)
+    assert reopened.list_collections() == ["ds"]
+    assert reopened.metadata("ds")["finished"] is True
+    assert reopened.count("ds") == 3
+
+    reopened.compact()
+    compacted = InMemoryStore(data_dir=data_dir)
+    assert compacted.count("ds") == 3
+
+
+def test_concurrent_inserts_thread_safe(store):
+    def writer(start):
+        store.insert_many("ds", [{ROW_ID: start + i} for i in range(100)])
+
+    threads = [threading.Thread(target=writer, args=(i * 100,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert store.count("ds") == 800
+
+
+def test_parse_query_accepts_json_and_python_repr():
+    assert parse_query("{}") == {}
+    assert parse_query('{"a": 1}') == {"a": 1}
+    assert parse_query("{'a': 1}") == {"a": 1}  # reference client's str(dict)
+    assert parse_query(None) == {}
+
+
+def test_matches_subset():
+    assert matches({"a": 1, "b": 2}, {"a": 1})
+    assert not matches({"a": 1}, {"a": 2})
+    assert not matches({"a": 1}, {"missing": 1})
+
+
+def test_insert_many_atomic_on_duplicate(store):
+    store.insert_one("ds", {ROW_ID: 1})
+    with pytest.raises(KeyError):
+        store.insert_many("ds", [{ROW_ID: 5}, {ROW_ID: 1}])
+    # nothing from the failed batch was applied
+    assert [d[ROW_ID] for d in store.find("ds")] == [1]
+
+
+def test_job_manager_rejects_active_duplicate_name():
+    import time as _time
+
+    from learningorchestra_tpu.core.jobs import JobManager
+
+    jm = JobManager()
+    jm.submit("j", _time.sleep, 0.3)
+    with pytest.raises(ValueError):
+        jm.submit("j", _time.sleep, 0.01)
+    jm.wait("j", timeout=5)
+    jm.submit("j", _time.sleep, 0.01)  # allowed after completion
+    assert jm.wait("j", timeout=5).state == "finished"

@@ -1,0 +1,91 @@
+"""ColumnTable: typing, encoding, matrices, store round-trips."""
+
+import numpy as np
+
+from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID
+from learningorchestra_tpu.core.table import ColumnTable, write_table
+
+
+def test_column_typing_and_nan():
+    table = ColumnTable.from_lists(
+        {"num": [1, 2.5, None], "txt": ["a", None, "b"], "mixed": [1, "x", 2]}
+    )
+    assert table.dtype_of("num") == "number"
+    assert table.dtype_of("txt") == "string"
+    assert table.dtype_of("mixed") == "string"
+    assert np.isnan(table.columns["num"][2])
+    assert table.number_fields() == ["num"]
+    assert sorted(table.string_fields()) == ["mixed", "txt"]
+
+
+def test_dropna_both_kinds():
+    table = ColumnTable.from_lists({"num": [1, None, 3], "txt": ["a", "b", None]})
+    clean = table.dropna()
+    assert clean.num_rows == 1
+    assert clean.columns["num"][0] == 1 and clean.columns["txt"][0] == "a"
+
+
+def test_encoded_matches_label_encoder_order():
+    # Codes in sorted order — the sklearn LabelEncoder convention the
+    # reference relies on (reference: pca.py:79-85).
+    table = ColumnTable.from_lists({"s": ["b", "a", "c", "a"]})
+    encoded, vocab = table.encoded()
+    assert vocab["s"] == ["a", "b", "c"]
+    np.testing.assert_array_equal(encoded.columns["s"], [1.0, 0.0, 2.0, 0.0])
+
+
+def test_matrix_shape_and_order():
+    table = ColumnTable.from_lists({"a": [1, 2], "b": [3, 4]})
+    mat = table.matrix(["b", "a"])
+    np.testing.assert_array_equal(mat, [[3, 1], [4, 2]])
+
+
+def test_store_roundtrip(store):
+    table = ColumnTable.from_lists({"x": [1.0, 2.0], "s": ["u", "v"]})
+    write_table(store, "out", table, {"filename": "out", "finished": True})
+    assert store.metadata("out")["filename"] == "out"
+    back = ColumnTable.from_store(store, "out")
+    np.testing.assert_array_equal(back.columns["x"], [1.0, 2.0])
+    assert list(back.columns["s"]) == ["u", "v"]
+
+
+def test_ingest_csv_contract(store, titanic_csv):
+    write_ingest_metadata(store, "titanic", titanic_csv)
+    meta = store.metadata("titanic")
+    assert meta["finished"] is False and meta["fields"] == "processing"
+
+    n = ingest_csv(store, "titanic", titanic_csv)
+    assert n == 8
+    meta = store.metadata("titanic")
+    assert meta["finished"] is True
+    assert meta["fields"][:3] == ["PassengerId", "Survived", "Pclass"]
+    rows = list(store.find("titanic", skip=1, limit=2))
+    assert rows[0][ROW_ID] == 1
+    # values stored as raw strings; missing cell preserved as empty string
+    assert rows[0]["Age"] == "22"
+    row6 = store.find_one("titanic", {ROW_ID: 6})
+    assert row6["Age"] == ""
+    # quoted comma survives
+    assert rows[0]["Name"] == "Braund, Mr. Owen"
+
+
+def test_ingest_rejects_html(store, tmp_path):
+    import pytest
+
+    from learningorchestra_tpu.core.ingest import IngestError, validate_csv_url
+
+    bad = tmp_path / "page.html"
+    bad.write_text("<html><body>hi</body></html>")
+    with pytest.raises(IngestError):
+        validate_csv_url(str(bad))
+
+
+def test_ingest_preserves_embedded_newlines(store, tmp_path):
+    path = tmp_path / "multiline.csv"
+    path.write_text('id,note\n1,"line1\nline2"\n2,plain\n')
+    from learningorchestra_tpu.core.ingest import ingest_csv
+
+    n = ingest_csv(store, "ml", str(path))
+    assert n == 2
+    assert store.find_one("ml", {ROW_ID: 1})["note"] == "line1\nline2"
