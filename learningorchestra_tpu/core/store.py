@@ -95,6 +95,19 @@ class DocumentStore:
         (Mongo ``update_one(filter, {"$set": ...})`` semantics)."""
         raise NotImplementedError
 
+    def set_field_values(
+        self, collection: str, field: str, values_by_id: dict
+    ) -> None:
+        """Bulk-write one field across many rows: ``{_id: new_value}``.
+
+        The columnar write path. The reference updates converted values one
+        ``update_one`` RPC per document (reference:
+        microservices/data_type_handler_image/data_type_handler.py:47-77);
+        backends implement this as a single batched mutation instead.
+        """
+        for doc_id, value in values_by_id.items():
+            self.update_one(collection, {ROW_ID: doc_id}, {field: value})
+
     # --- reads ----------------------------------------------------------------
     def find(
         self,
@@ -204,6 +217,17 @@ class InMemoryStore(DocumentStore):
                         self._apply_insert(record["c"], document)
                 elif op == "update":
                     self._apply_update(record["c"], record["q"], record["v"])
+                elif op == "set_field":
+                    # JSON round-trips dict keys to strings; recover int
+                    # row ids (non-int ids pass through unchanged).
+                    values_by_id = {}
+                    for doc_id, value in record["d"].items():
+                        try:
+                            doc_id = int(doc_id)
+                        except ValueError:
+                            pass
+                        values_by_id[doc_id] = value
+                    self._apply_set_field(record["c"], record["f"], values_by_id)
                 elif op == "drop":
                     self._collections.pop(record["c"], None)
 
@@ -242,6 +266,14 @@ class InMemoryStore(DocumentStore):
                 document.update(new_values)
                 return
 
+    def _apply_set_field(
+        self, collection: str, field: str, values_by_id: dict
+    ) -> None:
+        bucket = self._collections.get(collection, {})
+        for doc_id, value in values_by_id.items():
+            if doc_id in bucket:
+                bucket[doc_id][field] = value
+
     # --- DocumentStore implementation -----------------------------------------
     def list_collections(self) -> list[str]:
         with self._lock:
@@ -279,6 +311,15 @@ class InMemoryStore(DocumentStore):
         with self._lock:
             self._apply_update(collection, query, new_values)
             self._log({"op": "update", "c": collection, "q": query, "v": new_values})
+
+    def set_field_values(
+        self, collection: str, field: str, values_by_id: dict
+    ) -> None:
+        with self._lock:
+            self._apply_set_field(collection, field, values_by_id)
+            self._log(
+                {"op": "set_field", "c": collection, "f": field, "d": values_by_id}
+            )
 
     def find(
         self,

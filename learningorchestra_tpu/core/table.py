@@ -169,19 +169,23 @@ class ColumnTable:
         return out
 
 
-def write_table(
+BATCH_SIZE = 4096
+
+
+def write_documents(
     store: DocumentStore,
     collection: str,
-    table: ColumnTable,
+    documents: list[dict],
     metadata: dict,
-    batch_size: int = 4096,
+    batch_size: int = BATCH_SIZE,
 ) -> None:
-    """Write a table plus its ``_id: 0`` metadata document to the store.
+    """Write row documents plus an ``_id: 0`` metadata document.
 
-    Honors the ``finished``-flag wire contract: the metadata document is
-    inserted with ``finished: false`` first, and the caller's final
+    The single authoritative implementation of the ``finished``-flag wire
+    contract: the metadata document is inserted with ``finished: false``
+    first, rows land in ``insert_many`` batches, and the caller's final
     metadata (including ``finished: true`` if requested) is applied only
-    after the last row lands — so a concurrent poller never observes a
+    after the last row — so a concurrent poller never observes a
     "finished" dataset with partial rows.
     """
     meta = dict(metadata)
@@ -189,7 +193,18 @@ def write_table(
     initial = dict(meta)
     initial["finished"] = False
     store.insert_one(collection, initial)
-    documents = table.documents()
     for start in range(0, len(documents), batch_size):
         store.insert_many(collection, documents[start : start + batch_size])
     store.update_one(collection, {ROW_ID: METADATA_ID}, meta)
+
+
+def write_table(
+    store: DocumentStore,
+    collection: str,
+    table: ColumnTable,
+    metadata: dict,
+    batch_size: int = BATCH_SIZE,
+) -> None:
+    """Write a :class:`ColumnTable` to the store under the ``finished``
+    contract (see :func:`write_documents`)."""
+    write_documents(store, collection, table.documents(), metadata, batch_size)

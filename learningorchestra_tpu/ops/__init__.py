@@ -1,0 +1,19 @@
+"""Dataset operations: projection, type conversion, histogram, PCA, t-SNE.
+
+Each op is the TPU-native analogue of one reference microservice's logic
+module. Ops consume/produce collections in a
+:class:`~learningorchestra_tpu.core.store.DocumentStore` through bulk
+columnar reads and writes; the compute itself is numpy/JAX, not
+row-at-a-time RPCs.
+"""
+
+from learningorchestra_tpu.ops.projection import project
+from learningorchestra_tpu.ops.dtype import convert_field_types
+from learningorchestra_tpu.ops.histogram import create_histogram, value_counts
+
+__all__ = [
+    "project",
+    "convert_field_types",
+    "create_histogram",
+    "value_counts",
+]

@@ -1,0 +1,124 @@
+"""Value-count histograms.
+
+Reference behaviour (microservices/histogram_image/histogram.py:49-74):
+per requested field, push a ``$group``/``$sum: 1`` aggregation down to
+MongoDB and store the resulting ``[{_id: value, count: n}, ...]`` list as
+one document of a new histogram collection, plus a metadata document
+``{filename_parent, fields, filename, _id: 0}``.
+
+Two counting paths:
+
+- :func:`value_counts` — for raw store columns (host-resident Python
+  values). Exact float64 counting via ``np.unique``; putting arbitrary
+  float64 store values through a float32 device would silently perturb
+  the histogram keys.
+- :func:`device_value_counts` (and the jitted kernel
+  :func:`_sorted_unique_counts`) — for columns already living on device
+  as ``jax.Array``: one XLA sort + two scatters with a static output
+  shape. This is the path table-level compute (e.g. tree binning in
+  ``ml/``) uses, where the data is device-resident and device-width
+  anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore
+
+
+@jax.jit
+def _sorted_unique_counts(x: jax.Array):
+    """Unique values of ``x`` and their counts, compacted to the front.
+
+    Returns ``(values, counts, n_unique)`` where only the first
+    ``n_unique`` entries are meaningful; the tail is padding so the shape
+    stays static under jit. One device sort + two scatters — the on-device
+    analogue of the reference's server-side ``$group`` pushdown.
+    """
+    s = jnp.sort(x)
+    is_new = jnp.concatenate([jnp.ones(1, dtype=bool), s[1:] != s[:-1]])
+    group = jnp.cumsum(is_new) - 1
+    counts = jnp.zeros(x.shape, dtype=jnp.int32).at[group].add(1)
+    values = jnp.zeros(x.shape, dtype=x.dtype).at[group].set(s)
+    return values, counts, is_new.sum()
+
+
+def device_value_counts(x: jax.Array) -> tuple[np.ndarray, np.ndarray]:
+    """``(values, counts)`` of a device-resident numeric column."""
+    values, counts, n = _sorted_unique_counts(x)
+    n = int(n)
+    return np.asarray(values)[:n], np.asarray(counts)[:n]
+
+
+def value_counts(raw_values: list) -> list[tuple[object, int]]:
+    """``(value, count)`` pairs for one raw store column, sorted by value.
+
+    ``None``/NaN values form their own group (like Mongo's null group);
+    integral floats collapse to int so counts round-trip the dtype
+    converter (ops/dtype.py).
+    """
+    nulls = 0
+    numbers: list[float] = []
+    others: list = []
+    for value in raw_values:
+        if value is None or (isinstance(value, float) and np.isnan(value)):
+            nulls += 1
+        elif isinstance(value, (int, float, np.integer, np.floating)) and not isinstance(
+            value, bool
+        ):
+            numbers.append(float(value))
+        else:
+            others.append(value)
+
+    pairs: list[tuple[object, int]] = []
+    if numbers:
+        host_values, host_counts = np.unique(
+            np.asarray(numbers, dtype=np.float64), return_counts=True
+        )
+        for value, count in zip(host_values, host_counts):
+            value = float(value)
+            pairs.append((int(value) if value.is_integer() else value, int(count)))
+    if others:
+        host_values, host_counts = np.unique(
+            np.asarray(others, dtype=object), return_counts=True
+        )
+        for value, count in zip(host_values, host_counts):
+            pairs.append((value, int(count)))
+    if nulls:
+        pairs.append((None, nulls))
+    return pairs
+
+
+def create_histogram(
+    store: DocumentStore,
+    parent_filename: str,
+    histogram_filename: str,
+    fields: list[str],
+) -> None:
+    """Build the histogram collection with the reference's document shape
+    (histogram.py:50-74): metadata at ``_id: 0`` then one document per
+    field containing its ``[{_id: value, count: n}, ...]`` list."""
+    store.insert_one(
+        histogram_filename,
+        {
+            "filename_parent": parent_filename,
+            "fields": fields,
+            "filename": histogram_filename,
+            ROW_ID: METADATA_ID,
+        },
+    )
+    columns = store.read_columns(parent_filename, fields=fields)
+    for document_id, field in enumerate(fields, start=1):
+        store.insert_one(
+            histogram_filename,
+            {
+                field: [
+                    {"_id": value, "count": count}
+                    for value, count in value_counts(columns[field])
+                ],
+                ROW_ID: document_id,
+            },
+        )

@@ -1,0 +1,58 @@
+"""Column projection: parent dataset → new dataset with a field subset.
+
+Reference behaviour (microservices/projection_image/projection.py:71-125):
+a Spark job reads the parent collection, filters out the metadata row,
+``select``s the requested fields (plus ``_id``), appends the rows into the
+output collection, and writes a metadata document whose ``finished`` flag
+flips when the job completes.
+
+Here projection is a single bulk columnar move: one ``read_columns`` scan
+(fields + ``_id`` together, so values and row ids can never mis-pair) and
+one batched write under the ``finished`` contract. Row ``_id``s are
+preserved, matching the reference's appending of ``_id`` to the
+projection fields (projection_image/server.py:104-106). Values are copied
+raw — projection never coerces types; that is the fieldtypes service's
+job.
+"""
+
+from __future__ import annotations
+
+from learningorchestra_tpu.core.ingest import timestamp
+from learningorchestra_tpu.core.store import ROW_ID, DocumentStore
+from learningorchestra_tpu.core.table import write_documents
+
+
+def project(
+    store: DocumentStore,
+    parent_filename: str,
+    projection_filename: str,
+    fields: list[str],
+) -> int:
+    """Project ``fields`` of ``parent_filename`` into ``projection_filename``.
+
+    Returns the row count.
+    """
+    field_names = [field for field in fields if field != ROW_ID]
+    columns = store.read_columns(parent_filename, fields=field_names + [ROW_ID])
+    ids = columns.pop(ROW_ID)
+    num_rows = len(ids)
+
+    documents = []
+    for i in range(num_rows):
+        document = {name: columns[name][i] for name in field_names}
+        document[ROW_ID] = ids[i]
+        documents.append(document)
+
+    write_documents(
+        store,
+        projection_filename,
+        documents,
+        {
+            "filename": projection_filename,
+            "finished": True,
+            "time_created": timestamp(),
+            "parent_filename": parent_filename,
+            "fields": field_names,
+        },
+    )
+    return num_rows

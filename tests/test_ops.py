@@ -1,0 +1,110 @@
+"""Ops: projection, dtype conversion, histogram — store-level contracts."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID
+from learningorchestra_tpu.ops import (
+    convert_field_types,
+    create_histogram,
+    project,
+    value_counts,
+)
+
+
+@pytest.fixture()
+def ingested(store, titanic_csv):
+    write_ingest_metadata(store, "titanic", titanic_csv)
+    ingest_csv(store, "titanic", titanic_csv)
+    return store
+
+
+class TestProjection:
+    def test_projects_fields_and_preserves_ids(self, ingested):
+        n = project(ingested, "titanic", "proj", ["Name", "Age"])
+        assert n == 8
+        rows = [
+            d for d in ingested.find("proj") if d[ROW_ID] != METADATA_ID
+        ]
+        assert len(rows) == 8
+        assert set(rows[0].keys()) == {"Name", "Age", ROW_ID}
+        assert [r[ROW_ID] for r in rows] == list(range(1, 9))
+
+    def test_metadata_contract(self, ingested):
+        project(ingested, "titanic", "proj", ["Sex"])
+        meta = ingested.metadata("proj")
+        assert meta["finished"] is True
+        assert meta["parent_filename"] == "titanic"
+        assert meta["filename"] == "proj"
+        assert meta["fields"] == ["Sex"]
+
+    def test_id_in_fields_not_duplicated(self, ingested):
+        # the reference client appends _id to the field list itself
+        project(ingested, "titanic", "proj", ["Sex", ROW_ID])
+        meta = ingested.metadata("proj")
+        assert meta["fields"] == ["Sex"]
+
+
+class TestDtype:
+    def test_string_to_number(self, ingested):
+        convert_field_types(ingested, "titanic", {"Age": "number", "Fare": "number"})
+        rows = list(ingested.find("titanic", {ROW_ID: 1}))
+        assert rows[0]["Age"] == 22
+        assert isinstance(rows[0]["Age"], int)
+        assert rows[0]["Fare"] == 7.25
+
+    def test_empty_string_becomes_none(self, ingested):
+        convert_field_types(ingested, "titanic", {"Age": "number"})
+        row = next(ingested.find("titanic", {ROW_ID: 6}))
+        assert row["Age"] is None
+
+    def test_number_back_to_string(self, ingested):
+        convert_field_types(ingested, "titanic", {"Age": "number"})
+        convert_field_types(ingested, "titanic", {"Age": "string"})
+        row = next(ingested.find("titanic", {ROW_ID: 1}))
+        assert row["Age"] == "22"
+        row = next(ingested.find("titanic", {ROW_ID: 6}))
+        assert row["Age"] == ""
+
+    def test_invalid_number_raises(self, ingested):
+        with pytest.raises(ValueError):
+            convert_field_types(ingested, "titanic", {"Name": "number"})
+
+    def test_invalid_type_name_raises(self, ingested):
+        with pytest.raises(ValueError):
+            convert_field_types(ingested, "titanic", {"Age": "boolean"})
+
+
+class TestValueCounts:
+    def test_string_counts(self):
+        pairs = value_counts(["S", "C", "S", "Q", "S"])
+        assert dict(pairs) == {"S": 3, "C": 1, "Q": 1}
+
+    def test_numeric_counts_on_device(self):
+        pairs = value_counts([3, 1, 3, 3.0, 2.5])
+        assert dict(pairs) == {3: 3, 1: 1, 2.5: 1}
+
+    def test_nulls_grouped(self):
+        pairs = value_counts([None, 1.0, float("nan"), 1])
+        assert dict(pairs) == {1: 2, None: 2}
+
+    def test_large_column_matches_numpy(self, rng):
+        data = rng.integers(0, 50, size=10_000).astype(float)
+        expected_values, expected_counts = np.unique(data, return_counts=True)
+        pairs = value_counts(list(data))
+        assert [p[0] for p in pairs] == [int(v) for v in expected_values]
+        assert [p[1] for p in pairs] == list(expected_counts)
+
+
+class TestHistogram:
+    def test_document_shape(self, ingested):
+        create_histogram(ingested, "titanic", "hist", ["Sex", "Pclass"])
+        meta = ingested.metadata("hist")
+        assert meta["filename_parent"] == "titanic"
+        assert meta["fields"] == ["Sex", "Pclass"]
+        doc1 = next(ingested.find("hist", {ROW_ID: 1}))
+        counts = {entry["_id"]: entry["count"] for entry in doc1["Sex"]}
+        assert counts == {"male": 5, "female": 3}
+        doc2 = next(ingested.find("hist", {ROW_ID: 2}))
+        assert {e["_id"] for e in doc2["Pclass"]} == {"1", "3"}
