@@ -218,16 +218,11 @@ class InMemoryStore(DocumentStore):
                 elif op == "update":
                     self._apply_update(record["c"], record["q"], record["v"])
                 elif op == "set_field":
-                    # JSON round-trips dict keys to strings; recover int
-                    # row ids (non-int ids pass through unchanged).
-                    values_by_id = {}
-                    for doc_id, value in record["d"].items():
-                        try:
-                            doc_id = int(doc_id)
-                        except ValueError:
-                            pass
-                        values_by_id[doc_id] = value
-                    self._apply_set_field(record["c"], record["f"], values_by_id)
+                    # Logged as [id, value] pairs so JSON preserves the
+                    # id's type (dict keys would stringify int ids).
+                    self._apply_set_field(
+                        record["c"], record["f"], dict(record["d"])
+                    )
                 elif op == "drop":
                     self._collections.pop(record["c"], None)
 
@@ -318,7 +313,12 @@ class InMemoryStore(DocumentStore):
         with self._lock:
             self._apply_set_field(collection, field, values_by_id)
             self._log(
-                {"op": "set_field", "c": collection, "f": field, "d": values_by_id}
+                {
+                    "op": "set_field",
+                    "c": collection,
+                    "f": field,
+                    "d": list(values_by_id.items()),
+                }
             )
 
     def find(

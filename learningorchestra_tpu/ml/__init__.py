@@ -1,0 +1,29 @@
+"""Estimators: the TPU-native replacement for Spark MLlib classifiers.
+
+The reference trains five ``pyspark.ml.classification`` models (reference:
+microservices/model_builder_image/model_builder.py:7-13,151-157):
+LogisticRegression, DecisionTreeClassifier, RandomForestClassifier,
+GBTClassifier, NaiveBayes. Each estimator here reproduces that
+capability as batched JAX programs designed for the MXU — matmuls and
+histogram scatters over row-sharded device arrays — instead of JVM
+iterators.
+
+All estimators share one contract (``ml/base.py``): ``fit(X, y)`` returns
+a fitted model with ``predict``/``predict_proba``; ``mesh=`` shards rows
+over the ``data`` axis so multi-chip is a constructor knob, not a code
+change.
+"""
+
+from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
+from learningorchestra_tpu.ml.evaluation import accuracy_score, f1_score
+from learningorchestra_tpu.ml.logistic import LogisticRegression
+from learningorchestra_tpu.ml.naive_bayes import NaiveBayes
+
+__all__ = [
+    "CLASSIFIER_NAMES",
+    "make_classifier",
+    "accuracy_score",
+    "f1_score",
+    "LogisticRegression",
+    "NaiveBayes",
+]

@@ -1,0 +1,82 @@
+"""Shared estimator contract and device data preparation.
+
+Design notes (TPU-first):
+
+- Features travel as one dense ``(rows, features)`` float32 matrix —
+  the MXU wants large batched matmuls, not per-row documents.
+- Rows are padded to the mesh's ``data``-axis size and carried with a
+  validity mask (static shapes; XLA compiles one program per padded
+  shape). Every reduction in every estimator is mask-weighted, so
+  padding never biases a fit.
+- ``mesh=None`` means "all visible devices on the data axis" via the
+  same code path: single-chip is just a 1-wide mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.parallel.mesh import default_mesh
+from learningorchestra_tpu.parallel.sharding import shard_rows
+
+# The model-builder request contract (reference:
+# microservices/model_builder_image/model_builder.py:151-157,287-291).
+CLASSIFIER_NAMES = ("lr", "dt", "rf", "gb", "nb")
+
+
+def resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
+    return mesh if mesh is not None else default_mesh()
+
+
+def prepare_xy(
+    X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh
+) -> tuple[jax.Array, Optional[jax.Array], jax.Array]:
+    """Pad + row-shard features (float32), labels (int32) and the
+    validity mask over the mesh's data axis."""
+    X_dev, mask = shard_rows(np.asarray(X), mesh, dtype=np.float32)
+    y_dev = None
+    if y is not None:
+        y_dev, _ = shard_rows(np.asarray(y), mesh, dtype=np.int32)
+    return X_dev, y_dev, mask
+
+
+def infer_num_classes(y: np.ndarray) -> int:
+    """Labels are class indices 0..C-1 (the MLlib convention: label is a
+    double holding an index, reference docs/model_builder.md)."""
+    return int(np.max(y)) + 1 if len(y) else 1
+
+
+class FittedModel:
+    """Base for fitted models: numpy in, numpy out, device inside."""
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+def make_classifier(name: str, mesh: Optional[Mesh] = None):
+    """The classifier switcher (reference model_builder.py:151-157)."""
+    from learningorchestra_tpu.ml.logistic import LogisticRegression
+    from learningorchestra_tpu.ml.naive_bayes import NaiveBayes
+    from learningorchestra_tpu.ml.trees import (
+        DecisionTreeClassifier,
+        GBTClassifier,
+        RandomForestClassifier,
+    )
+
+    switcher = {
+        "lr": LogisticRegression,
+        "dt": DecisionTreeClassifier,
+        "rf": RandomForestClassifier,
+        "gb": GBTClassifier,
+        "nb": NaiveBayes,
+    }
+    if name not in switcher:
+        raise KeyError(name)
+    return switcher[name](mesh=mesh)

@@ -1,0 +1,61 @@
+"""Multiclass evaluation metrics: accuracy and weighted F1.
+
+Replaces the JVM ``MulticlassClassificationEvaluator`` the reference uses
+with ``metricName`` "f1" and "accuracy" (reference:
+microservices/model_builder_image/model_builder.py:205-224). Spark's
+"f1" is the *weighted* F1: per-class F1 averaged with true-class support
+weights. Both metrics reduce to one confusion matrix, built on device
+with a single scatter-add.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def confusion_matrix(
+    y_true: jax.Array, y_pred: jax.Array, num_classes: int
+) -> jax.Array:
+    """``(num_classes, num_classes)`` counts, rows = true class."""
+    index = y_true.astype(jnp.int32) * num_classes + y_pred.astype(jnp.int32)
+    flat = jnp.zeros(num_classes * num_classes, dtype=jnp.float32).at[index].add(1.0)
+    return flat.reshape(num_classes, num_classes)
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _metrics(y_true: jax.Array, y_pred: jax.Array, num_classes: int):
+    cm = confusion_matrix(y_true, y_pred, num_classes)
+    total = cm.sum()
+    accuracy = jnp.trace(cm) / total
+    true_positive = jnp.diag(cm)
+    support = cm.sum(axis=1)          # actual count per class
+    predicted = cm.sum(axis=0)        # predicted count per class
+    precision = jnp.where(predicted > 0, true_positive / predicted, 0.0)
+    recall = jnp.where(support > 0, true_positive / support, 0.0)
+    f1 = jnp.where(
+        precision + recall > 0, 2 * precision * recall / (precision + recall), 0.0
+    )
+    weighted_f1 = (f1 * support).sum() / total
+    return accuracy, weighted_f1
+
+
+def accuracy_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    num_classes = int(max(np.max(y_true), np.max(y_pred))) + 1
+    accuracy, _ = _metrics(
+        jnp.asarray(y_true, jnp.int32), jnp.asarray(y_pred, jnp.int32), num_classes
+    )
+    return float(accuracy)
+
+
+def f1_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:
+    """Weighted multiclass F1 (Spark ``metricName="f1"`` semantics)."""
+    num_classes = int(max(np.max(y_true), np.max(y_pred))) + 1
+    _, weighted_f1 = _metrics(
+        jnp.asarray(y_true, jnp.int32), jnp.asarray(y_pred, jnp.int32), num_classes
+    )
+    return float(weighted_f1)

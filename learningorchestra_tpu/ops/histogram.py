@@ -6,51 +6,19 @@ MongoDB and store the resulting ``[{_id: value, count: n}, ...]`` list as
 one document of a new histogram collection, plus a metadata document
 ``{filename_parent, fields, filename, _id: 0}``.
 
-Two counting paths:
-
-- :func:`value_counts` — for raw store columns (host-resident Python
-  values). Exact float64 counting via ``np.unique``; putting arbitrary
-  float64 store values through a float32 device would silently perturb
-  the histogram keys.
-- :func:`device_value_counts` (and the jitted kernel
-  :func:`_sorted_unique_counts`) — for columns already living on device
-  as ``jax.Array``: one XLA sort + two scatters with a static output
-  shape. This is the path table-level compute (e.g. tree binning in
-  ``ml/``) uses, where the data is device-resident and device-width
-  anyway.
+Counting is host-side and exact: the raw store column holds arbitrary
+Python values (float64, strings, whatever ``update_one`` wrote), and
+pushing floats through a float32 device would silently perturb the
+histogram keys. Device-side histogramming of already-binned device data
+lives where it is actually hot: the tree-split histograms in
+``ml/trees.py``.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore
-
-
-@jax.jit
-def _sorted_unique_counts(x: jax.Array):
-    """Unique values of ``x`` and their counts, compacted to the front.
-
-    Returns ``(values, counts, n_unique)`` where only the first
-    ``n_unique`` entries are meaningful; the tail is padding so the shape
-    stays static under jit. One device sort + two scatters — the on-device
-    analogue of the reference's server-side ``$group`` pushdown.
-    """
-    s = jnp.sort(x)
-    is_new = jnp.concatenate([jnp.ones(1, dtype=bool), s[1:] != s[:-1]])
-    group = jnp.cumsum(is_new) - 1
-    counts = jnp.zeros(x.shape, dtype=jnp.int32).at[group].add(1)
-    values = jnp.zeros(x.shape, dtype=x.dtype).at[group].set(s)
-    return values, counts, is_new.sum()
-
-
-def device_value_counts(x: jax.Array) -> tuple[np.ndarray, np.ndarray]:
-    """``(values, counts)`` of a device-resident numeric column."""
-    values, counts, n = _sorted_unique_counts(x)
-    n = int(n)
-    return np.asarray(values)[:n], np.asarray(counts)[:n]
 
 
 def value_counts(raw_values: list) -> list[tuple[object, int]]:
@@ -82,11 +50,13 @@ def value_counts(raw_values: list) -> list[tuple[object, int]]:
             value = float(value)
             pairs.append((int(value) if value.is_integer() else value, int(count)))
     if others:
-        host_values, host_counts = np.unique(
-            np.asarray(others, dtype=object), return_counts=True
-        )
-        for value, count in zip(host_values, host_counts):
-            pairs.append((value, int(count)))
+        # Dict-based: a mixed-type column (e.g. strings + booleans) has
+        # no total order, so no sorting-based unique.
+        counts: dict = {}
+        for value in others:
+            counts[value] = counts.get(value, 0) + 1
+        for value in sorted(counts, key=str):
+            pairs.append((value, counts[value]))
     if nulls:
         pairs.append((None, nulls))
     return pairs

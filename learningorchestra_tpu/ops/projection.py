@@ -33,6 +33,14 @@ def project(
     Returns the row count.
     """
     field_names = [field for field in fields if field != ROW_ID]
+    metadata = store.metadata(parent_filename)
+    known = metadata.get("fields") if metadata else None
+    if isinstance(known, list):
+        missing = [field for field in field_names if field not in known]
+        if missing:
+            raise KeyError(
+                f"fields {missing} not in dataset {parent_filename!r}"
+            )
     columns = store.read_columns(parent_filename, fields=field_names + [ROW_ID])
     ids = columns.pop(ROW_ID)
     num_rows = len(ids)

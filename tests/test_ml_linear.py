@@ -1,0 +1,103 @@
+"""Estimators vs sklearn/numpy oracles: evaluation, logistic, naive bayes."""
+
+import numpy as np
+import pytest
+import sklearn.linear_model
+import sklearn.metrics
+import sklearn.naive_bayes
+
+from learningorchestra_tpu.ml import (
+    LogisticRegression,
+    NaiveBayes,
+    accuracy_score,
+    f1_score,
+)
+
+
+@pytest.fixture()
+def blobs(rng):
+    """Linearly separable-ish 3-class data."""
+    n, f, c = 600, 5, 3
+    centers = rng.normal(size=(c, f)) * 3
+    y = rng.integers(0, c, size=n)
+    X = centers[y] + rng.normal(size=(n, f))
+    return X, y
+
+
+class TestEvaluation:
+    def test_accuracy_matches_sklearn(self, rng):
+        y_true = rng.integers(0, 4, size=500)
+        y_pred = rng.integers(0, 4, size=500)
+        assert accuracy_score(y_true, y_pred) == pytest.approx(
+            sklearn.metrics.accuracy_score(y_true, y_pred)
+        )
+
+    def test_weighted_f1_matches_sklearn(self, rng):
+        y_true = rng.integers(0, 4, size=500)
+        y_pred = rng.integers(0, 4, size=500)
+        assert f1_score(y_true, y_pred) == pytest.approx(
+            sklearn.metrics.f1_score(y_true, y_pred, average="weighted"), abs=1e-6
+        )
+
+    def test_perfect_prediction(self):
+        y = np.array([0, 1, 2, 1, 0])
+        assert accuracy_score(y, y) == 1.0
+        assert f1_score(y, y) == 1.0
+
+
+class TestLogisticRegression:
+    def test_separates_blobs(self, blobs):
+        X, y = blobs
+        model = LogisticRegression(max_iter=50).fit(X, y)
+        assert accuracy_score(y, model.predict(X)) > 0.95
+
+    def test_agrees_with_sklearn(self, blobs):
+        X, y = blobs
+        ours = LogisticRegression(max_iter=100).fit(X, y).predict(X)
+        theirs = (
+            sklearn.linear_model.LogisticRegression(C=1e6, max_iter=1000)
+            .fit(X, y)
+            .predict(X)
+        )
+        assert np.mean(ours == theirs) > 0.98
+
+    def test_proba_shape_and_normalization(self, blobs):
+        X, y = blobs
+        probs = LogisticRegression(max_iter=20).fit(X, y).predict_proba(X)
+        assert probs.shape == (len(X), 3)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_binary(self, rng):
+        X = rng.normal(size=(300, 4))
+        y = (X[:, 0] + X[:, 1] > 0).astype(int)
+        model = LogisticRegression(max_iter=50).fit(X, y)
+        assert accuracy_score(y, model.predict(X)) > 0.95
+
+
+class TestNaiveBayes:
+    def test_matches_sklearn_multinomial(self, rng):
+        X = rng.integers(0, 20, size=(400, 8)).astype(float)
+        y = rng.integers(0, 3, size=400)
+        ours = NaiveBayes().fit(X, y)
+        theirs = sklearn.naive_bayes.MultinomialNB(alpha=1.0).fit(X, y)
+        assert np.array_equal(ours.predict(X), theirs.predict(X))
+        np.testing.assert_allclose(
+            ours.predict_proba(X), theirs.predict_proba(X), atol=1e-4
+        )
+
+    def test_rejects_negative_features(self, rng):
+        X = rng.normal(size=(50, 3))
+        y = rng.integers(0, 2, size=50)
+        with pytest.raises(ValueError):
+            NaiveBayes().fit(X, y)
+
+    def test_padding_does_not_bias_fit(self, rng):
+        # 7 rows on an 8-device mesh → 1 padding row; priors must use
+        # only real rows.
+        X = rng.integers(0, 5, size=(7, 3)).astype(float)
+        y = np.array([0, 0, 0, 0, 1, 1, 1])
+        ours = NaiveBayes().fit(X, y)
+        theirs = sklearn.naive_bayes.MultinomialNB(alpha=1.0).fit(X, y)
+        np.testing.assert_allclose(
+            ours.predict_proba(X), theirs.predict_proba(X), atol=1e-4
+        )

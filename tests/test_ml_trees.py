@@ -1,0 +1,165 @@
+"""Tree estimators vs sklearn oracles and invariants."""
+
+import numpy as np
+import pytest
+import sklearn.ensemble
+import sklearn.tree
+
+from learningorchestra_tpu.ml.base import make_classifier
+from learningorchestra_tpu.ml.binning import apply_bins, make_thresholds
+from learningorchestra_tpu.ml.evaluation import accuracy_score
+from learningorchestra_tpu.ml.trees import (
+    DecisionTreeClassifier,
+    GBTClassifier,
+    RandomForestClassifier,
+)
+
+
+@pytest.fixture()
+def nonlinear(rng):
+    """XOR-ish data no linear model can fit: tests real tree splits."""
+    n = 800
+    X = rng.normal(size=(n, 6))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(int)
+    return X, y
+
+
+@pytest.fixture()
+def three_class(rng):
+    n = 900
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    return X, y
+
+
+class TestBinning:
+    def test_bins_are_monotone_with_values(self, rng):
+        X = rng.normal(size=(500, 3))
+        thresholds = make_thresholds(X, 32)
+        bins = np.asarray(apply_bins(X.astype(np.float32), thresholds.astype(np.float32)))
+        for f in range(3):
+            order = np.argsort(X[:, f])
+            assert (np.diff(bins[order, f]) >= 0).all()
+        assert bins.min() >= 0 and bins.max() < 32
+
+    def test_threshold_semantics(self):
+        # bin b holds thresholds[b-1] < x <= thresholds[b]
+        X = np.array([[1.0], [2.0], [3.0], [4.0]])
+        thresholds = np.array([[1.5, 2.5, 3.5]])
+        bins = np.asarray(apply_bins(X.astype(np.float32), thresholds.astype(np.float32)))
+        assert bins[:, 0].tolist() == [0, 1, 2, 3]
+
+
+class TestDecisionTree:
+    def test_solves_xor(self, nonlinear):
+        X, y = nonlinear
+        model = DecisionTreeClassifier().fit(X, y)
+        assert accuracy_score(y, model.predict(X)) > 0.9
+
+    def test_close_to_sklearn(self, three_class):
+        X, y = three_class
+        ours = DecisionTreeClassifier(max_depth=5).fit(X, y).predict(X)
+        theirs = (
+            sklearn.tree.DecisionTreeClassifier(max_depth=5, random_state=0)
+            .fit(X, y)
+            .predict(X)
+        )
+        assert np.mean(ours == theirs) > 0.9
+
+    def test_proba_normalized(self, nonlinear):
+        X, y = nonlinear
+        probs = DecisionTreeClassifier().fit(X, y).predict_proba(X)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_pure_node_stops_splitting(self, rng):
+        # Perfectly separable on one feature: tree must be exact.
+        X = rng.normal(size=(200, 3))
+        y = (X[:, 2] > 0).astype(int)
+        model = DecisionTreeClassifier().fit(X, y)
+        assert accuracy_score(y, model.predict(X)) == 1.0
+
+
+class TestRandomForest:
+    def test_solves_xor(self, nonlinear):
+        X, y = nonlinear
+        model = RandomForestClassifier().fit(X, y)
+        assert accuracy_score(y, model.predict(X)) > 0.9
+
+    def test_multiclass(self, three_class):
+        X, y = three_class
+        model = RandomForestClassifier().fit(X, y)
+        assert accuracy_score(y, model.predict(X)) > 0.85
+
+    def test_comparable_to_sklearn_generalization(self, rng):
+        n = 1200
+        X = rng.normal(size=(n, 6))
+        y = ((X[:, 0] * X[:, 1] > 0) & (X[:, 2] > -0.5)).astype(int)
+        X_train, X_test = X[:800], X[800:]
+        y_train, y_test = y[:800], y[800:]
+        ours = RandomForestClassifier().fit(X_train, y_train)
+        theirs = sklearn.ensemble.RandomForestClassifier(
+            n_estimators=20, max_depth=5, random_state=0
+        ).fit(X_train, y_train)
+        ours_acc = accuracy_score(y_test, ours.predict(X_test))
+        theirs_acc = theirs.score(X_test, y_test)
+        assert ours_acc > theirs_acc - 0.07
+
+
+class TestGBT:
+    def test_solves_xor(self, nonlinear):
+        X, y = nonlinear
+        model = GBTClassifier().fit(X, y)
+        assert accuracy_score(y, model.predict(X)) > 0.9
+
+    def test_rejects_multiclass(self, three_class):
+        X, y = three_class
+        with pytest.raises(ValueError):
+            GBTClassifier().fit(X, y)
+
+    def test_proba_binary_shape(self, nonlinear):
+        X, y = nonlinear
+        probs = GBTClassifier(rounds=5).fit(X, y).predict_proba(X)
+        assert probs.shape == (len(X), 2)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_comparable_to_sklearn_generalization(self, rng):
+        n = 1200
+        X = rng.normal(size=(n, 6))
+        y = ((X[:, 0] * X[:, 1] > 0) & (X[:, 2] > -0.5)).astype(int)
+        X_train, X_test = X[:800], X[800:]
+        y_train, y_test = y[:800], y[800:]
+        ours = GBTClassifier().fit(X_train, y_train)
+        theirs = sklearn.ensemble.GradientBoostingClassifier(
+            n_estimators=20, max_depth=5, random_state=0
+        ).fit(X_train, y_train)
+        ours_acc = accuracy_score(y_test, ours.predict(X_test))
+        theirs_acc = theirs.score(X_test, y_test)
+        assert ours_acc > theirs_acc - 0.07
+
+
+class TestSwitcher:
+    def test_all_five_names(self, nonlinear):
+        X, y = nonlinear
+        for name in ("lr", "dt", "rf", "gb", "nb"):
+            clf = make_classifier(name)
+            model = clf.fit(np.abs(X) if name == "nb" else X, y)
+            assert model.predict(X[:10]).shape == (10,)
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(KeyError):
+            make_classifier("svm")
+
+
+class TestNaNRouting:
+    def test_nan_rows_route_same_at_fit_and_predict(self, rng):
+        # NaN in the split feature: training bins NaN into the last bin
+        # (right); prediction must send it right too.
+        n = 400
+        X = rng.normal(size=(n, 2))
+        X[: n // 4, 0] = np.nan
+        y = np.where(np.isnan(X[:, 0]), 1, (X[:, 0] > 0).astype(int))
+        model = DecisionTreeClassifier().fit(X, y)
+        pred = model.predict(X)
+        nan_rows = np.isnan(X[:, 0])
+        assert (pred[nan_rows] == 1).mean() > 0.95
+        assert accuracy_score(y, pred) > 0.95

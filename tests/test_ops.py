@@ -108,3 +108,27 @@ class TestHistogram:
         assert counts == {"male": 5, "female": 3}
         doc2 = next(ingested.find("hist", {ROW_ID: 2}))
         assert {e["_id"] for e in doc2["Pclass"]} == {"1", "3"}
+
+
+class TestReviewRegressions:
+    def test_projection_missing_field_raises(self, ingested):
+        with pytest.raises(KeyError):
+            project(ingested, "titanic", "proj", ["Agee"])
+        # metadata was never marked finished with bogus rows
+        meta = ingested.metadata("proj")
+        assert meta is None or not meta.get("finished")
+
+    def test_value_counts_mixed_unorderable_types(self):
+        pairs = value_counts(["a", True, "a", None])
+        assert dict(pairs) == {"a": 2, True: 1, None: 1}
+
+    def test_wal_set_field_preserves_id_types(self, tmp_path):
+        from learningorchestra_tpu.core.store import InMemoryStore
+
+        store = InMemoryStore(data_dir=str(tmp_path))
+        store.insert_one("c", {ROW_ID: 1, "x": "a"})
+        store.insert_one("c", {ROW_ID: "7", "x": "b"})
+        store.set_field_values("c", "x", {1: "A", "7": "B"})
+        reopened = InMemoryStore(data_dir=str(tmp_path))
+        assert next(reopened.find("c", {ROW_ID: 1}))["x"] == "A"
+        assert next(reopened.find("c", {ROW_ID: "7"}))["x"] == "B"
