@@ -1,0 +1,111 @@
+"""`pyspark` import shim + the preprocessor-code runner.
+
+The reference ``exec()``s user code that begins with real PySpark imports
+(``from pyspark.ml import Pipeline``, ``from pyspark.sql.functions import
+...``, ``from pyspark.ml.feature import ...`` — reference
+docs/model_builder.md example). For that exact code to run unchanged on
+this framework, those module paths must resolve — so this module
+registers lightweight ``pyspark.*`` modules in ``sys.modules`` backed by
+our expression/feature implementations.
+
+Running user-supplied code is the reference's documented contract
+(model_builder.py:144-145 ``exec(preprocessor_code, ...)``), arbitrary
+code execution included; deployments that need isolation should sandbox
+the model-builder service process, exactly as they would the reference's.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+from learningorchestra_tpu.frame import expressions as _expressions
+from learningorchestra_tpu.frame import feature as _feature
+
+
+def _module(name: str, **attrs) -> types.ModuleType:
+    module = types.ModuleType(name)
+    for key, value in attrs.items():
+        setattr(module, key, value)
+    return module
+
+
+def install_pyspark_shim() -> None:
+    """Register ``pyspark`` module aliases (idempotent; no-op when a real
+    pyspark is importable first — it isn't in this framework's image)."""
+    if "pyspark" in sys.modules:
+        return
+    functions = _module(
+        "pyspark.sql.functions",
+        col=_expressions.col,
+        lit=_expressions.lit,
+        when=_expressions.when,
+        mean=_expressions.mean,
+        split=_expressions.split,
+        regexp_extract=_expressions.regexp_extract,
+    )
+    feature = _module(
+        "pyspark.ml.feature",
+        StringIndexer=_feature.StringIndexer,
+        VectorAssembler=_feature.VectorAssembler,
+    )
+    ml = _module("pyspark.ml", Pipeline=_feature.Pipeline, feature=feature)
+    sql = _module("pyspark.sql", functions=functions)
+    pyspark = _module("pyspark", ml=ml, sql=sql)
+    sys.modules["pyspark"] = pyspark
+    sys.modules["pyspark.ml"] = ml
+    sys.modules["pyspark.ml.feature"] = feature
+    sys.modules["pyspark.sql"] = sql
+    sys.modules["pyspark.sql.functions"] = functions
+
+
+def fields_from_dataframe(dataframe, is_string: bool) -> list[str]:
+    """The helper the reference exposes to preprocessor code
+    (model_builder.py:118-131): classify columns by the type of the
+    first row's value."""
+    first_row = dataframe.first()
+    names = []
+    for column in dataframe.schema.names:
+        value = first_row[column] if first_row is not None else None
+        if is_string == isinstance(value, str):
+            names.append(column)
+    return names
+
+
+def run_preprocessor(code: str, training_df, testing_df) -> dict:
+    """Execute user preprocessing code with the reference's environment
+    contract (docs/model_builder.md): ``training_df``/``testing_df`` in
+    scope; the code must bind ``features_training``, ``features_testing``
+    and ``features_evaluation`` (None allowed)."""
+    install_pyspark_shim()
+
+    class _SelfProxy:
+        """The reference exec()s code inside a method, so user code can
+        call ``self.fields_from_dataframe(...)``."""
+
+        @staticmethod
+        def fields_from_dataframe(dataframe, is_string):
+            return fields_from_dataframe(dataframe, is_string)
+
+    scope = {
+        "training_df": training_df,
+        "testing_df": testing_df,
+        "self": _SelfProxy(),
+        "fields_from_dataframe": fields_from_dataframe,
+    }
+    exec(code, scope, scope)
+    missing = [
+        name
+        for name in ("features_training", "features_testing", "features_evaluation")
+        if name not in scope
+    ]
+    if missing:
+        raise KeyError(
+            f"preprocessor_code must define {missing} "
+            "(reference contract, docs/model_builder.md)"
+        )
+    return {
+        "features_training": scope["features_training"],
+        "features_testing": scope["features_testing"],
+        "features_evaluation": scope["features_evaluation"],
+    }

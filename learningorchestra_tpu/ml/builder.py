@@ -1,0 +1,157 @@
+"""Model builder: the flagship pipeline — preprocess, fit N classifiers
+concurrently, evaluate, persist predictions.
+
+Reference behaviour (microservices/model_builder_image/model_builder.py:
+133-247): load train+test dataframes, ``exec`` user preprocessing, fan
+out one thread per requested classifier onto the shared Spark cluster
+(FAIR scheduler), time the fit, evaluate weighted-F1/accuracy when an
+evaluation split exists, then ``collect()`` predictions to the driver and
+insert them row-by-row.
+
+TPU-native differences: classifiers fit as jitted programs on the shared
+device mesh (threads overlap host prep and keep the reference's
+task-parallel shape, reference model_builder.py:94,159-175); predictions
+are written back in batched columnar writes, not 1 RPC per row.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor, wait
+from typing import Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.core.store import DocumentStore, ROW_ID
+from learningorchestra_tpu.core.table import ColumnTable
+from learningorchestra_tpu.frame.dataframe import DataFrame
+from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
+from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
+from learningorchestra_tpu.ml.evaluation import accuracy_score, f1_score
+
+FEATURES_COL = "features"
+LABEL_COL = "label"
+
+
+def load_dataframe(store: DocumentStore, filename: str) -> DataFrame:
+    """Dataset → DataFrame, metadata row/fields excluded (the reference
+    drops the metadata document and its fields, model_builder.py:96-116)."""
+    return DataFrame.from_table(ColumnTable.from_store(store, filename))
+
+
+def _prediction_documents(predicted_df: DataFrame) -> list[dict]:
+    """Row documents from a prediction frame: every column except the
+    assembled ``features`` vector (the reference also deletes
+    ``rawPrediction``, which we never materialize), ``probability`` as a
+    plain list (reference model_builder.py:232-247)."""
+    names = [n for n in predicted_df.columns if n != FEATURES_COL]
+    columns = {n: predicted_df._column(n) for n in names}
+    documents = []
+    for i in range(predicted_df.count()):
+        document = {}
+        for name in names:
+            column = columns[name]
+            if column.ndim > 1:
+                document[name] = [float(v) for v in column[i]]
+            elif column.dtype == object:
+                document[name] = column[i]
+            else:
+                value = float(column[i])
+                document[name] = None if np.isnan(value) else value
+        document[ROW_ID] = i + 1
+        documents.append(document)
+    return documents
+
+
+def train_one(
+    store: DocumentStore,
+    classificator_name: str,
+    features_training: DataFrame,
+    features_testing: DataFrame,
+    features_evaluation: Optional[DataFrame],
+    prediction_filename: str,
+    mesh: Optional[Mesh] = None,
+) -> dict:
+    """Fit + evaluate + persist one classifier (the reference's
+    ``classificator_handler``, model_builder.py:178-230). Returns the
+    prediction collection's metadata document."""
+    output_name = f"{prediction_filename}_prediction_{classificator_name}"
+    metadata = {
+        "filename": output_name,
+        "classificator": classificator_name,
+        ROW_ID: 0,
+    }
+
+    X_train = features_training.feature_matrix(FEATURES_COL)
+    y_train = features_training.label_vector(LABEL_COL)
+
+    classifier = make_classifier(classificator_name, mesh=mesh)
+    fit_start = time.time()
+    model = classifier.fit(X_train, y_train)
+    metadata["fit_time"] = time.time() - fit_start
+
+    if features_evaluation is not None:
+        X_eval = features_evaluation.feature_matrix(FEATURES_COL)
+        y_eval = features_evaluation.label_vector(LABEL_COL)
+        eval_pred = model.predict(X_eval)
+        # Stored as strings, matching the reference's metadata document
+        # (model_builder.py:223-224, values shown in docs/database_api.md).
+        metadata["F1"] = str(f1_score(y_eval, eval_pred))
+        metadata["accuracy"] = str(accuracy_score(y_eval, eval_pred))
+
+    X_test = features_testing.feature_matrix(FEATURES_COL)
+    prediction = model.predict(X_test)
+    probability = model.predict_proba(X_test)
+    predicted_df = features_testing.withColumn(
+        "prediction", prediction.astype(np.float64)
+    ).withColumn("probability", probability)
+
+    # Written directly (not via write_documents): prediction metadata has
+    # no ``finished`` flag in the reference either (model_builder.py:
+    # 191-196; document shape shown in docs/database_api.md:76-83).
+    store.drop(output_name)
+    store.insert_one(output_name, metadata)
+    documents = _prediction_documents(predicted_df)
+    for start in range(0, len(documents), 4096):
+        store.insert_many(output_name, documents[start : start + 4096])
+    return metadata
+
+
+def build_model(
+    store: DocumentStore,
+    training_filename: str,
+    test_filename: str,
+    preprocessor_code: str,
+    classificators_list: list[str],
+    mesh: Optional[Mesh] = None,
+) -> list[dict]:
+    """The reference's ``build_model`` (model_builder.py:133-176):
+    preprocess once, then one thread per classifier."""
+    unknown = [n for n in classificators_list if n not in CLASSIFIER_NAMES]
+    if unknown:
+        raise KeyError(f"invalid classificator names {unknown}")
+
+    training_df = load_dataframe(store, training_filename)
+    testing_df = load_dataframe(store, test_filename)
+    out = run_preprocessor(preprocessor_code, training_df, testing_df)
+
+    results: list[dict] = []
+    with ThreadPoolExecutor(max_workers=len(classificators_list) or 1) as pool:
+        futures = [
+            pool.submit(
+                train_one,
+                store,
+                name,
+                out["features_training"],
+                out["features_testing"],
+                out["features_evaluation"],
+                test_filename,
+                mesh,
+            )
+            for name in classificators_list
+        ]
+        wait(futures)
+    for future in futures:
+        results.append(future.result())
+    return results

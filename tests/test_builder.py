@@ -1,0 +1,71 @@
+"""End-to-end model builder: Titanic-like data through the documented
+preprocessor into all five classifiers."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+from learningorchestra_tpu.core.store import ROW_ID
+from learningorchestra_tpu.ml.builder import build_model
+from learningorchestra_tpu.ops.dtype import convert_field_types
+from tests.test_frame import DOCUMENTED_PREPROCESSOR
+
+NUMERIC_FIELDS = ("PassengerId", "Survived", "Pclass", "Age", "SibSp", "Parch", "Fare")
+
+
+@pytest.fixture()
+def titanic_store(store, titanic_csv):
+    for name in ("titanic_train", "titanic_test"):
+        write_ingest_metadata(store, name, titanic_csv)
+        ingest_csv(store, name, titanic_csv)
+        convert_field_types(store, name, {f: "number" for f in NUMERIC_FIELDS})
+    return store
+
+
+class TestBuildModel:
+    def test_lr_and_nb(self, titanic_store):
+        results = build_model(
+            titanic_store,
+            "titanic_train",
+            "titanic_test",
+            DOCUMENTED_PREPROCESSOR,
+            ["lr", "nb"],
+        )
+        assert {r["classificator"] for r in results} == {"lr", "nb"}
+        for result in results:
+            name = result["filename"]
+            assert name.startswith("titanic_test_prediction_")
+            meta = titanic_store.find_one(name, {ROW_ID: 0})
+            assert meta["fit_time"] > 0
+            assert "F1" in meta and isinstance(meta["F1"], str)
+            assert "accuracy" in meta and isinstance(meta["accuracy"], str)
+            rows = [
+                d
+                for d in titanic_store.find(name)
+                if d[ROW_ID] != 0
+            ]
+            assert len(rows) == 8
+            assert "prediction" in rows[0]
+            assert isinstance(rows[0]["probability"], list)
+            assert "features" not in rows[0]
+
+    def test_invalid_classifier_raises(self, titanic_store):
+        with pytest.raises(KeyError):
+            build_model(
+                titanic_store,
+                "titanic_train",
+                "titanic_test",
+                DOCUMENTED_PREPROCESSOR,
+                ["svm"],
+            )
+
+    def test_no_evaluation_split(self, titanic_store):
+        code = DOCUMENTED_PREPROCESSOR.replace(
+            "(features_training, features_evaluation) =\\\n"
+            "    features_training.randomSplit([0.8, 0.2], seed=33)",
+            "features_evaluation = None",
+        )
+        results = build_model(
+            titanic_store, "titanic_train", "titanic_test", code, ["nb"]
+        )
+        assert "F1" not in results[0]

@@ -1,0 +1,282 @@
+"""DataFrame facade: expressions, verbs, feature stages, and the full
+documented preprocessor example running verbatim."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+from learningorchestra_tpu.core.table import ColumnTable
+from learningorchestra_tpu.frame import (
+    DataFrame,
+    StringIndexer,
+    VectorAssembler,
+    col,
+    lit,
+    regexp_extract,
+    when,
+)
+from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
+from learningorchestra_tpu.ops.dtype import convert_field_types
+
+
+@pytest.fixture()
+def df():
+    return DataFrame.from_table(
+        ColumnTable.from_lists(
+            {
+                "name": ["Braund, Mr. Owen", "Cumings, Mrs. John", None],
+                "age": [22.0, None, 26.0],
+                "fare": [7.25, 71.28, 7.92],
+            }
+        )
+    )
+
+
+class TestExpressions:
+    def test_arithmetic(self, df):
+        out = df.withColumn("double_fare", col("fare") * 2 + 1)
+        np.testing.assert_allclose(
+            out._column("double_fare"), [15.5, 143.56, 16.84]
+        )
+
+    def test_when_isnull_otherwise(self, df):
+        out = df.withColumn(
+            "age", when(df["age"].isNull(), 99).otherwise(df["age"])
+        )
+        np.testing.assert_allclose(out._column("age"), [22, 99, 26])
+
+    def test_equality_with_null_is_false(self, df):
+        out = df.withColumn("is_b", when(df["name"] == "Braund, Mr. Owen", 1).otherwise(0))
+        np.testing.assert_allclose(out._column("is_b"), [1, 0, 0])
+
+    def test_regexp_extract(self, df):
+        out = df.withColumn(
+            "title", regexp_extract(col("name"), r"([A-Za-z]+)\.", 1)
+        )
+        assert list(out._column("title")) == ["Mr", "Mrs", None]
+
+    def test_compound_condition(self, df):
+        out = df.withColumn(
+            "flag",
+            when((df["fare"] > 7) & (df["age"].isNull()), 1).otherwise(0),
+        )
+        np.testing.assert_allclose(out._column("flag"), [0, 1, 0])
+
+
+class TestVerbs:
+    def test_rename_drop_columns(self, df):
+        out = df.withColumnRenamed("fare", "price").drop("name")
+        assert out.columns == ["age", "price"]
+
+    def test_na_fill_dict(self, df):
+        out = df.na.fill({"age": 0, "name": "unknown"})
+        assert out._column("age")[1] == 0
+        assert out._column("name")[2] == "unknown"
+
+    def test_replace_list(self, df):
+        out = df.replace(["Braund, Mr. Owen"], ["X"])
+        assert out._column("name")[0] == "X"
+
+    def test_random_split_deterministic(self, df):
+        big = DataFrame({"x": np.arange(1000, dtype=np.float64)})
+        a1, b1 = big.randomSplit([0.8, 0.2], seed=33)
+        a2, b2 = big.randomSplit([0.8, 0.2], seed=33)
+        assert a1.count() == a2.count() and b1.count() == b2.count()
+        assert a1.count() + b1.count() == 1000
+        assert abs(a1.count() - 800) < 60
+
+    def test_first_and_schema(self, df):
+        row = df.first()
+        assert row["name"] == "Braund, Mr. Owen"
+        assert row["age"] == 22.0
+        assert df.schema.names == ["name", "age", "fare"]
+
+
+class TestFeatureStages:
+    def test_string_indexer_frequency_desc(self):
+        df = DataFrame.from_table(
+            ColumnTable.from_lists({"c": ["b", "a", "b", "c", "b", "a"]})
+        )
+        model = StringIndexer(inputCol="c", outputCol="c_index").fit(df)
+        assert model.labels == ["b", "a", "c"]
+        out = model.transform(df)
+        np.testing.assert_allclose(out._column("c_index"), [0, 1, 0, 2, 0, 1])
+
+    def test_string_indexer_unseen_errors(self):
+        df = DataFrame.from_table(ColumnTable.from_lists({"c": ["a", "b"]}))
+        model = StringIndexer(inputCol="c").fit(df)
+        other = DataFrame.from_table(ColumnTable.from_lists({"c": ["z"]}))
+        with pytest.raises(ValueError):
+            model.transform(other)
+
+    def test_vector_assembler_skip(self, df):
+        assembler = VectorAssembler(
+            inputCols=["age", "fare"], outputCol="features"
+        ).setHandleInvalid("skip")
+        out = assembler.transform(df)
+        assert out.count() == 2  # the null-age row was skipped
+        assert out.feature_matrix().shape == (2, 2)
+
+    def test_vector_assembler_error(self, df):
+        assembler = VectorAssembler(inputCols=["age"], outputCol="features")
+        with pytest.raises(ValueError):
+            assembler.transform(df)
+
+
+# The documented preprocessor example, verbatim from the reference's
+# docs/model_builder.md (the compatibility contract for user code).
+DOCUMENTED_PREPROCESSOR = r"""
+from pyspark.ml import Pipeline
+from pyspark.sql.functions import (
+    mean, col, split,
+    regexp_extract, when, lit)
+
+from pyspark.ml.feature import (
+    VectorAssembler,
+    StringIndexer
+)
+
+TRAINING_DF_INDEX = 0
+TESTING_DF_INDEX = 1
+
+training_df = training_df.withColumnRenamed('Survived', 'label')
+testing_df = testing_df.withColumn('label', lit(0))
+datasets_list = [training_df, testing_df]
+
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.withColumn(
+        "Initial",
+        regexp_extract(col("Name"), "([A-Za-z]+)\.", 1))
+    datasets_list[index] = dataset
+
+misspelled_initials = [
+    'Mlle', 'Mme', 'Ms', 'Dr',
+    'Major', 'Lady', 'Countess',
+    'Jonkheer', 'Col', 'Rev',
+    'Capt', 'Sir', 'Don'
+]
+correct_initials = [
+    'Miss', 'Miss', 'Miss', 'Mr',
+    'Mr', 'Mrs', 'Mrs',
+    'Other', 'Other', 'Other',
+    'Mr', 'Mr', 'Mr'
+]
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.replace(misspelled_initials, correct_initials)
+    datasets_list[index] = dataset
+
+initials_age = {"Miss": 22,
+                "Other": 46,
+                "Master": 5,
+                "Mr": 33,
+                "Mrs": 36}
+for index, dataset in enumerate(datasets_list):
+    for initial, initial_age in initials_age.items():
+        dataset = dataset.withColumn(
+            "Age",
+            when((dataset["Initial"] == initial) &
+                 (dataset["Age"].isNull()), initial_age).otherwise(
+                    dataset["Age"]))
+        datasets_list[index] = dataset
+
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.na.fill({"Embarked": 'S'})
+    datasets_list[index] = dataset
+
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.withColumn("Family_Size", col('SibSp')+col('Parch'))
+    dataset = dataset.withColumn('Alone', lit(0))
+    dataset = dataset.withColumn(
+        "Alone",
+        when(dataset["Family_Size"] == 0, 1).otherwise(dataset["Alone"]))
+    datasets_list[index] = dataset
+
+text_fields = ["Sex", "Embarked", "Initial"]
+for column in text_fields:
+    for index, dataset in enumerate(datasets_list):
+        dataset = StringIndexer(
+            inputCol=column, outputCol=column+"_index").\
+                fit(dataset).\
+                transform(dataset)
+        datasets_list[index] = dataset
+
+non_required_columns = ["Name", "Embarked", "Sex", "Initial"]
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.drop(*non_required_columns)
+    datasets_list[index] = dataset
+
+training_df = datasets_list[TRAINING_DF_INDEX]
+testing_df = datasets_list[TESTING_DF_INDEX]
+
+assembler = VectorAssembler(
+    inputCols=training_df.columns[:],
+    outputCol="features")
+assembler.setHandleInvalid('skip')
+
+features_training = assembler.transform(training_df)
+(features_training, features_evaluation) =\
+    features_training.randomSplit([0.8, 0.2], seed=33)
+features_testing = assembler.transform(testing_df)
+"""
+
+
+class TestDocumentedPreprocessor:
+    def test_runs_verbatim(self, store, titanic_csv):
+        write_ingest_metadata(store, "titanic", titanic_csv)
+        ingest_csv(store, "titanic", titanic_csv)
+        convert_field_types(
+            store,
+            "titanic",
+            {
+                f: "number"
+                for f in ("PassengerId", "Survived", "Pclass", "Age", "SibSp", "Parch", "Fare")
+            },
+        )
+        table = ColumnTable.from_store(store, "titanic")
+        training_df = DataFrame.from_table(table)
+        testing_df = DataFrame.from_table(table).drop("Survived")
+
+        out = run_preprocessor(DOCUMENTED_PREPROCESSOR, training_df, testing_df)
+        features_training = out["features_training"]
+        features_testing = out["features_testing"]
+        features_evaluation = out["features_evaluation"]
+
+        assert "features" in features_training.columns
+        assert "label" in features_training.columns
+        n_train = features_training.count()
+        n_eval = features_evaluation.count()
+        assert n_train + n_eval == 8  # no rows lost: Age was imputed
+        assert features_testing.count() == 8
+        # assembled width: label,PassengerId,Pclass,Age,SibSp,Parch,Fare,
+        # Family_Size,Alone,Sex_index,Embarked_index,Initial_index
+        assert features_training.feature_matrix().shape[1] == 12
+        # label round-trips for training
+        labels = features_training.label_vector()
+        assert set(labels) <= {0, 1}
+
+
+class TestReviewRegressions:
+    def test_ne_null_is_false(self, df):
+        out = df.filter(df["name"] != "Braund, Mr. Owen")
+        assert list(out._column("name")) == ["Cumings, Mrs. John"]
+
+    def test_na_fill_scalar_type_matching(self, df):
+        filled = df.na.fill("S")  # string fill skips numeric columns
+        assert np.isnan(filled._column("age")[1])
+        assert filled._column("name")[2] == "S"
+        filled = df.na.fill(0)  # numeric fill skips string columns
+        assert filled._column("age")[1] == 0
+        assert filled._column("name")[2] is None
+
+    def test_when_without_otherwise_numeric_nan(self, df):
+        out = df.withColumn("flag", when(df["fare"] > 7.5, 1))
+        flag = out._column("flag")
+        assert flag.dtype == np.float64
+        assert np.isnan(flag[0]) and flag[1] == 1
+        bumped = out.withColumn("flag2", col("flag") + 1)
+        assert bumped._column("flag2")[1] == 2
+
+    def test_label_vector_rejects_nan(self, df):
+        frame = df.withColumnRenamed("age", "label")
+        with pytest.raises(ValueError):
+            frame.label_vector()
