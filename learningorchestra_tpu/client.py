@@ -1,0 +1,333 @@
+"""Python client — API-compatible with `learning_orchestra_client` 1.0.1.
+
+Reference: learning_orchestra_client/learning_orchestra_client/
+__init__.py:1-370. Same classes (``Context``, ``DatabaseApi``,
+``Projection``, ``DataTypeHandler``, ``Histogram``, ``Tsne``, ``Pca``,
+``Model``), same method signatures, same hard-coded service ports, same
+poll-until-``finished`` synchronization (3 s interval,
+``AsyncronousWait``) and the same ``ResponseTreat`` semantics (pretty
+JSON string by default, raise on 4xx, raw text on 5xx). A user script
+written against the reference client runs against this framework by
+changing only the import.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import requests
+
+cluster_url = None
+
+
+class Context:
+    def __init__(self, ip_from_cluster: str):
+        global cluster_url
+        cluster_url = "http://" + ip_from_cluster
+
+
+class AsyncronousWait:
+    WAIT_TIME = 3
+    METADATA_INDEX = 0
+
+    def wait(self, filename: str, pretty_response: bool = True) -> None:
+        if pretty_response:
+            print(
+                "\n----------" + " WAITING " + filename + " FINISH " + "----------"
+            )
+        database_api = DatabaseApi()
+        while True:
+            time.sleep(self.WAIT_TIME)
+            response = database_api.read_file(
+                filename, limit=1, pretty_response=False
+            )
+            if len(response["result"]) == 0:
+                continue
+            if response["result"][self.METADATA_INDEX]["finished"]:
+                break
+
+
+class ResponseTreat:
+    HTTP_CREATED = 201
+    HTTP_SUCESS = 200
+    HTTP_ERROR = 500
+
+    def treatment(self, response, pretty_response: bool = True):
+        if response.status_code >= self.HTTP_ERROR:
+            return response.text
+        elif response.status_code not in (self.HTTP_SUCESS, self.HTTP_CREATED):
+            raise Exception(response.json()["result"])
+        elif pretty_response:
+            return json.dumps(response.json(), indent=2)
+        else:
+            return response.json()
+
+
+class DatabaseApi:
+    DATABASE_API_PORT = "5000"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = cluster_url + ":" + self.DATABASE_API_PORT + "/files"
+        self.asyncronous_wait = AsyncronousWait()
+
+    def read_resume_files(self, pretty_response: bool = True):
+        if pretty_response:
+            print("\n----------" + " READ RESUME FILES " + "----------")
+        return ResponseTreat().treatment(requests.get(self.url_base), pretty_response)
+
+    def read_file(
+        self, filename, skip=0, limit=10, query={}, pretty_response: bool = True
+    ):
+        if pretty_response:
+            print("\n----------" + " READ FILE " + filename + " ----------")
+        request_params = {"skip": str(skip), "limit": str(limit), "query": str(query)}
+        response = requests.get(
+            url=self.url_base + "/" + filename, params=request_params
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def create_file(self, filename, url, pretty_response: bool = True):
+        if pretty_response:
+            print("\n----------" + " CREATE FILE " + filename + " ----------")
+        response = requests.post(
+            url=self.url_base, json={"filename": filename, "url": url}
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def delete_file(self, filename, pretty_response: bool = True):
+        if pretty_response:
+            print("\n----------" + " DELETE FILE " + filename + " ----------")
+        self.asyncronous_wait.wait(filename, pretty_response)
+        response = requests.delete(url=self.url_base + "/" + filename)
+        return ResponseTreat().treatment(response, pretty_response)
+
+
+class Projection:
+    PROJECTION_PORT = "5001"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = cluster_url + ":" + self.PROJECTION_PORT + "/projections"
+        self.asyncronous_wait = AsyncronousWait()
+
+    def create_projection(
+        self, filename, projection_filename, fields, pretty_response: bool = True
+    ):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " CREATE PROJECTION FROM "
+                + filename
+                + " TO "
+                + projection_filename
+                + " ----------"
+            )
+        self.asyncronous_wait.wait(filename, pretty_response)
+        response = requests.post(
+            url=self.url_base + "/" + filename,
+            json={"projection_filename": projection_filename, "fields": fields},
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+
+class Histogram:
+    HISTOGRAM_PORT = "5004"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = cluster_url + ":" + self.HISTOGRAM_PORT + "/histograms"
+        self.asyncronous_wait = AsyncronousWait()
+
+    def create_histogram(
+        self, filename, histogram_filename, fields, pretty_response: bool = True
+    ):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " CREATE HISTOGRAM FROM "
+                + filename
+                + " TO "
+                + histogram_filename
+                + " ----------"
+            )
+        self.asyncronous_wait.wait(filename, pretty_response)
+        response = requests.post(
+            url=self.url_base + "/" + filename,
+            json={"histogram_filename": histogram_filename, "fields": fields},
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+
+class Tsne:
+    TSNE_PORT = "5005"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = cluster_url + ":" + self.TSNE_PORT + "/images"
+        self.asyncronous_wait = AsyncronousWait()
+
+    def create_image_plot(
+        self, tsne_filename, parent_filename, label_name=None, pretty_response=True
+    ):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " CREATE t-SNE IMAGE PLOT FROM "
+                + parent_filename
+                + " TO "
+                + tsne_filename
+                + " ----------"
+            )
+        self.asyncronous_wait.wait(parent_filename, pretty_response)
+        response = requests.post(
+            url=self.url_base + "/" + parent_filename,
+            json={"tsne_filename": tsne_filename, "label_name": label_name},
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def delete_image_plot(self, tsne_filename, pretty_response=True):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " DELETE "
+                + tsne_filename
+                + "  t-SNE IMAGE PLOT "
+                + "----------"
+            )
+        response = requests.delete(url=self.url_base + "/" + tsne_filename)
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def read_image_plot_filenames(self, pretty_response=True):
+        if pretty_response:
+            print("\n---------- READE IMAGE PLOT FILENAMES " + " ----------")
+        return ResponseTreat().treatment(requests.get(self.url_base), pretty_response)
+
+    def read_image_plot(self, tsne_filename, pretty_response=True):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " READ "
+                + tsne_filename
+                + " t-SNE IMAGE PLOT "
+                + "----------"
+            )
+        return self.url_base + "/" + tsne_filename
+
+
+class Pca:
+    PCA_PORT = "5006"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = cluster_url + ":" + self.PCA_PORT + "/images"
+        self.asyncronous_wait = AsyncronousWait()
+
+    def create_image_plot(
+        self, pca_filename, parent_filename, label_name=None, pretty_response=True
+    ):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " CREATE PCA IMAGE PLOT FROM "
+                + parent_filename
+                + " TO "
+                + pca_filename
+                + " ----------"
+            )
+        self.asyncronous_wait.wait(parent_filename, pretty_response)
+        response = requests.post(
+            url=self.url_base + "/" + parent_filename,
+            json={"pca_filename": pca_filename, "label_name": label_name},
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def delete_image_plot(self, pca_filename, pretty_response=True):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " DELETE "
+                + pca_filename
+                + " PCA IMAGE PLOT "
+                + "----------"
+            )
+        response = requests.delete(url=self.url_base + "/" + pca_filename)
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def read_image_plot_filenames(self, pretty_response=True):
+        if pretty_response:
+            print("\n---------- READE IMAGE PLOT FILENAMES " + " ----------")
+        return ResponseTreat().treatment(requests.get(self.url_base), pretty_response)
+
+    def read_image_plot(self, pca_filename, pretty_response=True):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " READ "
+                + pca_filename
+                + " PCA IMAGE PLOT "
+                + "----------"
+            )
+        return self.url_base + "/" + pca_filename
+
+
+class DataTypeHandler:
+    DATA_TYPE_HANDLER_PORT = "5003"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = (
+            cluster_url + ":" + self.DATA_TYPE_HANDLER_PORT + "/fieldtypes"
+        )
+        self.asyncronous_wait = AsyncronousWait()
+
+    def change_file_type(self, filename, fields_dict, pretty_response: bool = True):
+        if pretty_response:
+            print(
+                "\n----------" + " CHANGE " + filename + " FILE TYPE " + "----------"
+            )
+        self.asyncronous_wait.wait(filename, pretty_response)
+        response = requests.patch(
+            url=self.url_base + "/" + filename, json=fields_dict
+        )
+        return ResponseTreat().treatment(response, pretty_response)
+
+
+class Model:
+    MODEL_BUILDER_PORT = "5002"
+
+    def __init__(self):
+        global cluster_url
+        self.url_base = cluster_url + ":" + self.MODEL_BUILDER_PORT + "/models"
+        self.asyncronous_wait = AsyncronousWait()
+
+    def create_model(
+        self,
+        training_filename,
+        test_filename,
+        preprocessor_code,
+        model_classificator,
+        pretty_response: bool = True,
+    ):
+        if pretty_response:
+            print(
+                "\n----------"
+                + " CREATE MODEL WITH "
+                + training_filename
+                + " AND "
+                + test_filename
+                + " ----------"
+            )
+        self.asyncronous_wait.wait(training_filename, pretty_response)
+        self.asyncronous_wait.wait(test_filename, pretty_response)
+        response = requests.post(
+            url=self.url_base,
+            json={
+                "training_filename": training_filename,
+                "test_filename": test_filename,
+                "preprocessor_code": preprocessor_code,
+                "classificators_list": model_classificator,
+            },
+        )
+        return ResponseTreat().treatment(response, pretty_response)

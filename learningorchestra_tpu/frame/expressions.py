@@ -59,6 +59,12 @@ class Expression:
     def __truediv__(self, other):
         return self._binary(other, np.divide)
 
+    def __rtruediv__(self, other):
+        return BinaryOp(lit(other), self, np.divide)
+
+    def __neg__(self):
+        return BinaryOp(lit(0.0), self, np.subtract)
+
     def __eq__(self, other):  # type: ignore[override]
         return self._binary(other, None, comparison=True)
 
@@ -132,7 +138,7 @@ class Alias(Expression):
 
 
 class BinaryOp(Expression):
-    def __init__(self, left, right, fn: Optional[Callable], comparison: bool):
+    def __init__(self, left, right, fn: Optional[Callable], comparison: bool = False):
         self.left = left if isinstance(left, Expression) else Literal(left)
         self.right = right if isinstance(right, Expression) else Literal(right)
         self.fn = fn
@@ -166,13 +172,11 @@ class BinaryOp(Expression):
             np.less,
             np.less_equal,
         ):
+            left_f = left.astype(np.float64)
+            right_f = right.astype(np.float64)
             with np.errstate(invalid="ignore"):
-                result = self.fn(
-                    left.astype(np.float64), right.astype(np.float64)
-                )
-            return result & ~np.isnan(left.astype(np.float64)) & ~np.isnan(
-                right.astype(np.float64)
-            )
+                result = self.fn(left_f, right_f)
+            return result & ~np.isnan(left_f) & ~np.isnan(right_f)
         return self.fn(left, right)
 
 
@@ -262,10 +266,12 @@ class Split(Expression):
 
     def evaluate(self, df) -> np.ndarray:
         values = _as_array(self.child.evaluate(df), df.count())
-        return np.array(
-            [None if v is None else self.pattern.split(str(v)) for v in values],
-            dtype=object,
-        )
+        # Per-slot assignment: np.array(list-of-equal-length-lists) would
+        # silently build a 2-D object matrix instead of a list column.
+        out = np.empty(len(values), dtype=object)
+        for i, value in enumerate(values):
+            out[i] = None if value is None else self.pattern.split(str(value))
+        return out
 
 
 class GetItem(Expression):

@@ -24,7 +24,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from learningorchestra_tpu.core.store import DocumentStore, ROW_ID
-from learningorchestra_tpu.core.table import ColumnTable
+from learningorchestra_tpu.core.table import BATCH_SIZE, ColumnTable
 from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
@@ -113,8 +113,8 @@ def train_one(
     store.drop(output_name)
     store.insert_one(output_name, metadata)
     documents = _prediction_documents(predicted_df)
-    for start in range(0, len(documents), 4096):
-        store.insert_many(output_name, documents[start : start + 4096])
+    for start in range(0, len(documents), BATCH_SIZE):
+        store.insert_many(output_name, documents[start : start + BATCH_SIZE])
     return metadata
 
 

@@ -1,0 +1,79 @@
+"""Embedding → scatter-plot PNG pipeline shared by the PCA and t-SNE
+services.
+
+Reference behaviour (microservices/pca_image/pca.py:74-98 and
+tsne_image/tsne.py:74-102): load the dataset, ``dropna()``, LabelEncode
+string columns, embed to 2-D, seaborn scatter (hue = label column when
+given), save ``<name>.png`` into the images volume.
+
+Here the load is one bulk columnar read, the string encoding is
+:meth:`ColumnTable.encoded` (same sorted-vocabulary order as sklearn's
+LabelEncoder), and the embedding runs on device (ops/pca.py, ops/tsne.py)
+instead of single-host sklearn. Only the final PNG rasterization stays on
+host — plot rendering is not TPU work (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.core.table import ColumnTable
+from learningorchestra_tpu.ops.pca import pca_embedding
+from learningorchestra_tpu.ops.tsne import tsne_embedding
+
+IMAGE_FORMAT = ".png"
+
+EMBEDDINGS: dict[str, Callable] = {
+    "pca": pca_embedding,
+    "tsne": tsne_embedding,
+}
+
+
+def _scatter_png(
+    embedded: np.ndarray, hue: Optional[np.ndarray], image_path: str
+) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+    import seaborn as sns
+
+    figure, axes = plt.subplots()
+    try:
+        if hue is not None:
+            sns.scatterplot(
+                x=embedded[:, 0], y=embedded[:, 1], hue=hue, ax=axes
+            )
+        else:
+            sns.scatterplot(x=embedded[:, 0], y=embedded[:, 1], ax=axes)
+        figure.savefig(image_path)
+    finally:
+        plt.close(figure)
+
+
+def create_embedding_image(
+    store: DocumentStore,
+    parent_filename: str,
+    label_name: Optional[str],
+    output_filename: str,
+    images_path: str,
+    method: str,
+) -> str:
+    """Embed ``parent_filename`` with ``method`` ("pca"/"tsne") and write
+    ``<images_path>/<output_filename>.png``. Returns the image path."""
+    embed = EMBEDDINGS[method]
+    table = ColumnTable.from_store(store, parent_filename).dropna()
+    encoded, _ = table.encoded()
+    X = encoded.matrix()
+    embedded = embed(X)
+    hue = None
+    if label_name is not None:
+        hue = np.asarray(encoded.columns[label_name])
+    os.makedirs(images_path, exist_ok=True)
+    image_path = os.path.join(images_path, output_filename + IMAGE_FORMAT)
+    _scatter_png(embedded, hue, image_path)
+    return image_path

@@ -1,0 +1,50 @@
+"""PCA on device: covariance + eigendecomposition, all matmuls.
+
+Replaces the reference's driver-side ``sklearn.decomposition.PCA(
+n_components=2).fit_transform`` (reference: microservices/pca_image/
+pca.py:87-88), which first collapses the whole dataset to one host via
+``toPandas()`` (pca.py:80) — the scalability cliff called out in
+SURVEY.md §3.4.
+
+TPU shape: center, form the ``(features, features)`` Gram matrix with one
+``Xᵀ @ X`` matmul — on row-sharded data that contraction IS the
+cross-chip reduction — then ``eigh`` the tiny covariance and project with
+a second matmul. No host round-trip of the data, ever.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.ml.base import prepare_xy, resolve_mesh
+
+
+@partial(jax.jit, static_argnames=("n_components",))
+def _pca(X, mask, n_components: int):
+    weights = mask.astype(X.dtype)
+    n = weights.sum()
+    mean = (X * weights[:, None]).sum(axis=0) / n
+    centered = (X - mean) * weights[:, None]
+    covariance = centered.T @ centered / (n - 1)
+    eigenvalues, eigenvectors = jnp.linalg.eigh(covariance)
+    # eigh is ascending; take the top components, largest first.
+    components = eigenvectors[:, ::-1][:, :n_components]
+    explained = eigenvalues[::-1][:n_components]
+    return centered @ components, components, explained
+
+
+def pca_embedding(
+    X: np.ndarray, n_components: int = 2, mesh: Optional[Mesh] = None
+) -> np.ndarray:
+    """Project rows onto the top principal components. Returns
+    ``(rows, n_components)``."""
+    mesh = resolve_mesh(mesh)
+    X_dev, _, mask = prepare_xy(X, None, mesh)
+    embedded, _, _ = _pca(X_dev, mask, n_components)
+    return np.asarray(embedded)[: len(X)]

@@ -1,0 +1,173 @@
+"""Exact t-SNE as one jitted device program.
+
+Replaces the reference's driver-side ``sklearn.manifold.TSNE()
+.fit_transform`` (reference: microservices/tsne_image/tsne.py:87-88) —
+single-host, O(n²), the headline scalability cliff (SURVEY.md §3.4,
+BASELINE.json north-star metric).
+
+TPU shape: every stage is matmul/elementwise —
+
+- pairwise squared distances via ``‖x‖² + ‖y‖² − 2 X Xᵀ`` (MXU);
+- per-row bandwidth calibration to the target perplexity as a
+  vectorized 32-step bisection (no data-dependent Python control flow);
+- the gradient ``4 (diag(W·1) − W) Y`` as two matmuls per iteration
+  inside ``lax.fori_loop`` with momentum + adaptive gains, early
+  exaggeration folded in by phase.
+
+Memory is O(n²) on device, like exact t-SNE everywhere; the affinity
+build is chunked over row blocks (``lax.map``) so the transient
+distance tensor stays bounded. Defaults match the reference's sklearn
+0.23: perplexity 30, 1000 iterations, early exaggeration 12 for the
+first 250.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.ml.base import resolve_mesh
+
+PERPLEXITY = 30.0
+ITERATIONS = 1000
+EARLY_EXAGGERATION = 12.0
+EARLY_PHASE = 250
+LEARNING_RATE = 200.0
+CHUNK = 1024
+
+
+def _squared_distances(A, B):
+    return (
+        jnp.sum(A**2, axis=1)[:, None]
+        + jnp.sum(B**2, axis=1)[None, :]
+        - 2.0 * A @ B.T
+    )
+
+
+def _calibrate_row_block(block_distances, self_mask, perplexity):
+    """Per-row Gaussian bandwidths matching ``log(perplexity)`` entropy,
+    by bisection on beta = 1/(2σ²). Fully vectorized over the block.
+    ``self_mask`` marks each row's own column — self-affinity is excluded
+    by INDEX, so duplicate rows keep their (maximal) mutual affinity like
+    sklearn's TSNE."""
+    target = jnp.log(perplexity)
+
+    def entropy_and_p(beta):
+        # numerically stable: distances are shifted per-row
+        logits = -block_distances * beta[:, None]
+        logits = logits - logits.max(axis=1, keepdims=True)
+        p = jnp.exp(logits)
+        p = p * ~self_mask
+        total = jnp.maximum(p.sum(axis=1, keepdims=True), 1e-12)
+        p = p / total
+        entropy = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0), axis=1)
+        return entropy, p
+
+    def bisect(state, _):
+        low, high, beta = state
+        entropy, _ = entropy_and_p(beta)
+        too_high = entropy > target  # entropy too high → increase beta
+        low = jnp.where(too_high, beta, low)
+        high = jnp.where(too_high, high, beta)
+        beta = jnp.where(
+            jnp.isinf(high), beta * 2.0, (low + high) / 2.0
+        )
+        return (low, high, beta), None
+
+    m = block_distances.shape[0]
+    init = (
+        jnp.zeros(m),
+        jnp.full(m, jnp.inf),
+        jnp.ones(m),
+    )
+    (_, _, beta), _ = jax.lax.scan(bisect, init, length=32)
+    _, p = entropy_and_p(beta)
+    return p
+
+
+@partial(jax.jit, static_argnames=("chunk",))
+def _affinities(X, perplexity, chunk: int):
+    """Symmetrized conditional affinities P, built block-of-rows at a
+    time so the distance transient is (chunk, n), not (n, n) twice."""
+    n = X.shape[0]
+    pad = (-n) % chunk
+    X_padded = jnp.pad(X, ((0, pad), (0, 0)))
+    blocks = X_padded.reshape(-1, chunk, X.shape[1])
+    offsets = jnp.arange(blocks.shape[0]) * chunk
+
+    def one_block(args):
+        block, offset = args
+        distances = _squared_distances(block, X)
+        rows = offset + jnp.arange(chunk)
+        self_mask = rows[:, None] == jnp.arange(n)[None, :]
+        return _calibrate_row_block(distances, self_mask, perplexity)
+
+    P = jax.lax.map(one_block, (blocks, offsets)).reshape(-1, n)[:n]
+    P = (P + P.T) / (2.0 * n)
+    return jnp.maximum(P, 1e-12)
+
+
+@partial(jax.jit, static_argnames=("iterations", "early_phase"))
+def _optimize(P, Y0, iterations: int, early_phase: int, learning_rate, exaggeration):
+    n = Y0.shape[0]
+
+    def gradient(Y, P_eff):
+        distances = _squared_distances(Y, Y)
+        inv = 1.0 / (1.0 + distances)
+        inv = inv * (1.0 - jnp.eye(n, dtype=Y.dtype))
+        Q = inv / jnp.maximum(inv.sum(), 1e-12)
+        W = (P_eff - jnp.maximum(Q, 1e-12)) * inv
+        return 4.0 * (W.sum(axis=1)[:, None] * Y - W @ Y)
+
+    def step(i, state):
+        Y, velocity, gains = state
+        P_eff = jnp.where(i < early_phase, P * exaggeration, P)
+        grad = gradient(Y, P_eff).astype(Y.dtype)
+        momentum = jnp.where(i < early_phase, 0.5, 0.8).astype(Y.dtype)
+        same_sign = jnp.sign(grad) == jnp.sign(velocity)
+        gains = jnp.maximum(
+            jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01
+        )
+        velocity = momentum * velocity - learning_rate * gains * grad
+        return Y + velocity, velocity, gains
+
+    Y, _, _ = jax.lax.fori_loop(
+        0,
+        iterations,
+        step,
+        (Y0, jnp.zeros_like(Y0), jnp.ones_like(Y0)),
+    )
+    return Y
+
+
+def tsne_embedding(
+    X: np.ndarray,
+    perplexity: float = PERPLEXITY,
+    iterations: int = ITERATIONS,
+    learning_rate: float = LEARNING_RATE,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+) -> np.ndarray:
+    """2-D t-SNE embedding of ``X``. Returns ``(rows, 2)``."""
+    resolve_mesh(mesh)  # device presence check; single program, no sharding yet
+    X = np.asarray(X, np.float32)
+    n = len(X)
+    perplexity = min(perplexity, max((n - 1) / 3.0, 1.0))
+    P = _affinities(jnp.asarray(X), jnp.float32(perplexity), min(CHUNK, n))
+    Y0 = (
+        jax.random.normal(jax.random.key(seed), (n, 2), jnp.float32) * 1e-4
+    )
+    Y = _optimize(
+        P,
+        Y0,
+        iterations,
+        min(EARLY_PHASE, iterations // 2),
+        jnp.float32(learning_rate),
+        jnp.float32(EARLY_EXAGGERATION),
+    )
+    return np.asarray(Y)

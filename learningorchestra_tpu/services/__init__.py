@@ -1,0 +1,27 @@
+"""The seven REST services, same surface as the reference microservices.
+
+Route table (identical paths, methods, ports, status codes and error
+strings — reference files cited per module):
+
+| port | service           | routes                                   |
+|------|-------------------|------------------------------------------|
+| 5000 | database_api      | POST/GET /files, GET/DELETE /files/<f>   |
+| 5001 | projection        | POST /projections/<parent>               |
+| 5002 | model_builder     | POST /models                             |
+| 5003 | data_type_handler | PATCH /fieldtypes/<f>                    |
+| 5004 | histogram         | POST /histograms/<parent>                |
+| 5005 | tsne              | POST/GET/DELETE /images[...]             |
+| 5006 | pca               | POST/GET/DELETE /images[...]             |
+
+Each module exposes ``create_app(store, ...) -> WebApp``; the reference's
+per-service Flask processes map to ``services.runner`` which serves any
+subset against a shared store.
+"""
+
+DATABASE_API_PORT = 5000
+PROJECTION_PORT = 5001
+MODEL_BUILDER_PORT = 5002
+DATA_TYPE_HANDLER_PORT = 5003
+HISTOGRAM_PORT = 5004
+TSNE_PORT = 5005
+PCA_PORT = 5006

@@ -1,0 +1,32 @@
+"""data_type_handler service (port 5003).
+
+Reference: microservices/data_type_handler_image/server.py:46-76. The
+request body IS the field→type dict; success message is ``file_changed``
+with status 200."""
+
+from __future__ import annotations
+
+from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.ops.dtype import convert_field_types
+from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.utils.web import WebApp
+
+MESSAGE_RESULT = "result"
+MESSAGE_CHANGED_FILE = "file_changed"
+
+
+def create_app(store: DocumentStore) -> WebApp:
+    app = WebApp("data_type_handler")
+
+    @app.route("/fieldtypes/<filename>", methods=("PATCH",))
+    def change_data_type(request, filename):
+        fields = request.get_json()
+        try:
+            validators.filename_exists(store, filename)
+            validators.field_types_valid(store, filename, fields)
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        convert_field_types(store, filename, fields)
+        return {MESSAGE_RESULT: MESSAGE_CHANGED_FILE}, 200
+
+    return app

@@ -1,0 +1,41 @@
+"""histogram service (port 5004).
+
+Reference: microservices/histogram_image/server.py:35-83. Duplicate
+output name → 409 with ``duplicated_filename`` (this service's string
+differs from projection's ``duplicate_file`` — reference
+histogram.py:25)."""
+
+from __future__ import annotations
+
+from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.ops.histogram import create_histogram
+from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.utils.web import WebApp
+
+MESSAGE_RESULT = "result"
+MESSAGE_CREATED_FILE = "created_file"
+
+
+def create_app(store: DocumentStore) -> WebApp:
+    app = WebApp("histogram")
+
+    @app.route("/histograms/<parent_filename>", methods=("POST",))
+    def create_histogram_route(request, parent_filename):
+        body = request.get_json()
+        histogram_filename = body["histogram_filename"]
+        fields = body["fields"]
+        try:
+            validators.filename_free(
+                store, histogram_filename, validators.MESSAGE_HISTOGRAM_DUPLICATE
+            )
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 409
+        try:
+            validators.filename_exists(store, parent_filename)
+            validators.fields_in_metadata(store, parent_filename, fields)
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        create_histogram(store, parent_filename, histogram_filename, list(fields))
+        return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    return app

@@ -1,0 +1,68 @@
+"""tsne (port 5005) and pca (port 5006) services — one parametrized app.
+
+Reference: microservices/tsne_image/server.py:57-163 and
+pca_image/server.py (identical shape; only the request key differs:
+``tsne_filename`` vs ``pca_filename``). Image-existence validation is
+filesystem-based, like the reference (tsne.py:162-175): duplicates → 409,
+missing on GET/DELETE → 404 with ``file_not_found``."""
+
+from __future__ import annotations
+
+import os
+
+from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.ops.images import IMAGE_FORMAT, create_embedding_image
+from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.utils.web import WebApp, send_file
+
+MESSAGE_RESULT = "result"
+MESSAGE_CREATED_FILE = "created_file"
+MESSAGE_DELETED_FILE = "deleted_file"
+
+
+def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
+    """``method`` is "tsne" or "pca"; the request filename key follows it."""
+    app = WebApp(method)
+    filename_key = f"{method}_filename"
+    os.makedirs(images_path, exist_ok=True)
+
+    def image_exists(name: str) -> bool:
+        return (name + IMAGE_FORMAT) in os.listdir(images_path)
+
+    @app.route("/images/<parent_filename>", methods=("POST",))
+    def create_image(request, parent_filename):
+        body = request.get_json()
+        output_filename = body[filename_key]
+        label_name = body.get("label_name")
+        if image_exists(output_filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
+        try:
+            validators.filename_exists(store, parent_filename)
+            validators.label_in_metadata(store, parent_filename, label_name)
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        create_embedding_image(
+            store, parent_filename, label_name, output_filename, images_path, method
+        )
+        return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    @app.route("/images", methods=("GET",))
+    def get_images(request):
+        return {MESSAGE_RESULT: os.listdir(images_path)}, 200
+
+    @app.route("/images/<filename>", methods=("GET",))
+    def get_image(request, filename):
+        if not image_exists(filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
+        return send_file(
+            os.path.join(images_path, filename + IMAGE_FORMAT), "image/png"
+        )
+
+    @app.route("/images/<filename>", methods=("DELETE",))
+    def delete_image(request, filename):
+        if not image_exists(filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
+        os.remove(os.path.join(images_path, filename + IMAGE_FORMAT))
+        return {MESSAGE_RESULT: MESSAGE_DELETED_FILE}, 200
+
+    return app

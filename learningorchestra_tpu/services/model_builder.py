@@ -1,0 +1,61 @@
+"""model_builder service (port 5002) — the flagship.
+
+Reference: microservices/model_builder_image/server.py:52-115. The
+request is synchronous: 201 only after ALL classifiers finish
+(server.py:112-115 — SURVEY.md §3.2 notes this is the one synchronous
+job in the reference)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES
+from learningorchestra_tpu.ml.builder import build_model
+from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.utils.web import WebApp
+
+MESSAGE_RESULT = "result"
+MESSAGE_CREATED_FILE = "created_file"
+
+
+def create_app(store: DocumentStore, mesh: Optional[Mesh] = None) -> WebApp:
+    app = WebApp("model_builder")
+
+    @app.route("/models", methods=("POST",))
+    def create_model(request):
+        body = request.get_json()
+        try:
+            validators.filename_exists(
+                store,
+                body["training_filename"],
+                validators.MESSAGE_INVALID_TRAINING_FILENAME,
+            )
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        try:
+            validators.filename_exists(
+                store,
+                body["test_filename"],
+                validators.MESSAGE_INVALID_TEST_FILENAME,
+            )
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        for name in body["classificators_list"]:
+            if name not in CLASSIFIER_NAMES:
+                return {
+                    MESSAGE_RESULT: validators.MESSAGE_INVALID_CLASSIFICATOR
+                }, 406
+        build_model(
+            store,
+            body["training_filename"],
+            body["test_filename"],
+            body["preprocessor_code"],
+            body["classificators_list"],
+            mesh=mesh,
+        )
+        return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    return app

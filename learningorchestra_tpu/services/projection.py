@@ -1,0 +1,39 @@
+"""projection service (port 5001).
+
+Reference: microservices/projection_image/server.py:50-115 — validator
+order matters (duplicate output name → 409 first, then parent existence
+→ 406, then fields → 406), and the reference appends ``_id`` to the
+requested fields before submitting (server.py:104-106)."""
+
+from __future__ import annotations
+
+from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.ops.projection import project
+from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.utils.web import WebApp
+
+MESSAGE_RESULT = "result"
+MESSAGE_CREATED_FILE = "created_file"
+
+
+def create_app(store: DocumentStore) -> WebApp:
+    app = WebApp("projection")
+
+    @app.route("/projections/<parent_filename>", methods=("POST",))
+    def create_projection(request, parent_filename):
+        body = request.get_json()
+        projection_filename = body["projection_filename"]
+        fields = body["fields"]
+        try:
+            validators.filename_free(store, projection_filename)
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 409
+        try:
+            validators.filename_exists(store, parent_filename)
+            validators.fields_in_metadata(store, parent_filename, fields)
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        project(store, parent_filename, projection_filename, list(fields))
+        return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    return app

@@ -1,0 +1,96 @@
+"""Serve the full service stack in one process.
+
+The reference deploys seven Flask containers wired to a shared MongoDB
+(docker-compose.yml); here the equivalent single-host bring-up is seven
+WSGI servers over one shared (WAL-backed) store. ``python -m
+learningorchestra_tpu.services.runner`` is the deployment entrypoint;
+``start_all`` is the programmatic/integration-test form.
+
+Environment:
+- ``LO_DATA_DIR`` — store WAL directory (default ``./lo_data``)
+- ``LO_IMAGES_DIR`` — PNG volume root (default ``<data>/images``)
+- ``LO_HOST`` — bind host (default 0.0.0.0)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from learningorchestra_tpu.core.jobs import JobManager
+from learningorchestra_tpu.core.store import DocumentStore, InMemoryStore
+from learningorchestra_tpu.services import (
+    DATA_TYPE_HANDLER_PORT,
+    DATABASE_API_PORT,
+    HISTOGRAM_PORT,
+    MODEL_BUILDER_PORT,
+    PCA_PORT,
+    PROJECTION_PORT,
+    TSNE_PORT,
+)
+from learningorchestra_tpu.services import (
+    data_type_handler,
+    database_api,
+    histogram,
+    images,
+    model_builder,
+    projection,
+)
+from learningorchestra_tpu.utils.web import ServerThread
+
+
+def build_apps(store: DocumentStore, images_dir: str) -> dict[int, object]:
+    return {
+        DATABASE_API_PORT: database_api.create_app(store, JobManager()),
+        PROJECTION_PORT: projection.create_app(store),
+        MODEL_BUILDER_PORT: model_builder.create_app(store),
+        DATA_TYPE_HANDLER_PORT: data_type_handler.create_app(store),
+        HISTOGRAM_PORT: histogram.create_app(store),
+        TSNE_PORT: images.create_app(
+            store, os.path.join(images_dir, "tsne"), "tsne"
+        ),
+        PCA_PORT: images.create_app(
+            store, os.path.join(images_dir, "pca"), "pca"
+        ),
+    }
+
+
+def start_all(
+    store: Optional[DocumentStore] = None,
+    images_dir: Optional[str] = None,
+    host: str = "127.0.0.1",
+) -> tuple[DocumentStore, list[ServerThread]]:
+    """Start all seven services on their reference ports; returns the
+    shared store and the server threads (callers stop() them)."""
+    store = store if store is not None else InMemoryStore()
+    images_dir = images_dir or os.path.join(os.getcwd(), "lo_images")
+    servers = [
+        ServerThread(app, host, port).start()
+        for port, app in build_apps(store, images_dir).items()
+    ]
+    return store, servers
+
+
+def main() -> None:
+    data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
+    images_dir = os.environ.get(
+        "LO_IMAGES_DIR", os.path.join(data_dir, "images")
+    )
+    host = os.environ.get("LO_HOST", "0.0.0.0")
+    store = InMemoryStore(data_dir=data_dir)
+    _, servers = start_all(store, images_dir, host)
+    print(
+        f"learningorchestra_tpu serving on ports 5000-5006 (host {host}); "
+        f"data in {data_dir}",
+        flush=True,
+    )
+    try:
+        for server in servers:
+            server._thread.join()
+    except KeyboardInterrupt:
+        for server in servers:
+            server.stop()
+
+
+if __name__ == "__main__":
+    main()

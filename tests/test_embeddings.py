@@ -1,0 +1,91 @@
+"""PCA and t-SNE embeddings: oracle comparisons and structure checks."""
+
+import os
+
+import numpy as np
+import pytest
+import sklearn.decomposition
+
+from learningorchestra_tpu.core.table import ColumnTable, write_table
+from learningorchestra_tpu.ops.images import create_embedding_image
+from learningorchestra_tpu.ops.pca import pca_embedding
+from learningorchestra_tpu.ops.tsne import tsne_embedding
+
+
+@pytest.fixture()
+def three_blobs(rng):
+    centers = np.array([[10, 0, 0, 0], [0, 10, 0, 0], [0, 0, 10, 0]])
+    labels = rng.integers(0, 3, size=240)
+    X = centers[labels] + rng.normal(size=(240, 4))
+    return X.astype(np.float64), labels
+
+
+def _knn_label_agreement(embedded, labels):
+    """Fraction of points whose nearest neighbour shares their label."""
+    d = ((embedded[:, None, :] - embedded[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d, np.inf)
+    return (labels[d.argmin(axis=1)] == labels).mean()
+
+
+class TestPca:
+    def test_matches_sklearn_up_to_sign(self, three_blobs):
+        X, _ = three_blobs
+        ours = pca_embedding(X, n_components=2)
+        theirs = sklearn.decomposition.PCA(n_components=2).fit_transform(X)
+        for component in range(2):
+            ratio = np.corrcoef(ours[:, component], theirs[:, component])[0, 1]
+            assert abs(ratio) > 0.999
+
+    def test_separates_blobs(self, three_blobs):
+        X, labels = three_blobs
+        embedded = pca_embedding(X)
+        assert _knn_label_agreement(embedded, labels) > 0.95
+
+
+class TestTsne:
+    def test_separates_blobs(self, three_blobs):
+        X, labels = three_blobs
+        embedded = tsne_embedding(X, iterations=500, seed=0)
+        assert embedded.shape == (len(X), 2)
+        assert _knn_label_agreement(embedded, labels) > 0.9
+
+    def test_small_n_perplexity_clamp(self, rng):
+        X = rng.normal(size=(8, 3))
+        embedded = tsne_embedding(X, iterations=50)
+        assert embedded.shape == (8, 2)
+        assert np.isfinite(embedded).all()
+
+
+class TestImagePipeline:
+    def test_creates_png_with_label_hue(self, store, three_blobs, tmp_path):
+        X, labels = three_blobs
+        table = ColumnTable.from_lists(
+            {
+                "a": X[:, 0].tolist(),
+                "b": X[:, 1].tolist(),
+                "c": X[:, 2].tolist(),
+                "label": [("x", "y", "z")[l] for l in labels],
+            }
+        )
+        write_table(store, "blobs", table, {"filename": "blobs", "finished": True})
+        path = create_embedding_image(
+            store, "blobs", "label", "blobs_pca", str(tmp_path), "pca"
+        )
+        assert os.path.exists(path)
+        assert open(path, "rb").read(8).startswith(b"\x89PNG")
+
+
+class TestReviewRegressions:
+    def test_duplicate_rows_keep_max_affinity(self, rng):
+        # label-encoded categorical tables routinely contain identical
+        # rows; a duplicate must be its twin's highest-affinity
+        # neighbour (self excluded by index, not by distance == 0).
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.ops.tsne import _affinities
+
+        base = rng.normal(size=(20, 3)).astype(np.float32)
+        X = np.vstack([base, base[:1]])  # row 20 duplicates row 0
+        P = np.asarray(_affinities(jnp.asarray(X), jnp.float32(5.0), 21))
+        assert P[0].argmax() == 20 and P[20].argmax() == 0
+        assert P[0, 20] > 10 * np.median(P[0])

@@ -280,3 +280,20 @@ class TestReviewRegressions:
         frame = df.withColumnRenamed("age", "label")
         with pytest.raises(ValueError):
             frame.label_vector()
+
+    def test_split_equal_lengths_stays_1d(self, df):
+        from learningorchestra_tpu.frame.expressions import split
+
+        frame = DataFrame.from_table(
+            ColumnTable.from_lists({"s": ["a b", "c d", "e f"]})
+        )
+        out = frame.withColumn("parts", split(col("s"), " "))
+        parts = out._column("parts")
+        assert parts.ndim == 1 and parts[0] == ["a", "b"]
+
+    def test_reflected_div_and_neg(self, df):
+        out = df.withColumn("inv", 1 / col("fare")).withColumn(
+            "neg", -col("fare")
+        )
+        np.testing.assert_allclose(out._column("inv")[0], 1 / 7.25)
+        np.testing.assert_allclose(out._column("neg")[0], -7.25)

@@ -1,0 +1,121 @@
+"""Full-stack integration: all seven services on their real ports, driven
+by the API-compatible client — the reference's Titanic walkthrough
+(reference learning_orchestra_client/readme.md "usage example";
+SURVEY.md §4 calls it the de-facto integration test)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import learningorchestra_tpu.client as lo_client
+from learningorchestra_tpu.client import (
+    Context,
+    DatabaseApi,
+    DataTypeHandler,
+    Histogram,
+    Model,
+    Pca,
+    Projection,
+)
+from learningorchestra_tpu.core.store import InMemoryStore
+from learningorchestra_tpu.services.runner import start_all
+from tests.test_frame import DOCUMENTED_PREPROCESSOR
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    store = InMemoryStore()
+    images_dir = str(tmp_path_factory.mktemp("images"))
+    try:
+        store, servers = start_all(store, images_dir)
+    except OSError as error:
+        pytest.skip(f"service ports busy: {error}")
+    lo_client.AsyncronousWait.WAIT_TIME = 0.05  # fast polls in tests
+    Context("127.0.0.1")
+    yield store
+    for server in servers:
+        server.stop()
+
+
+@pytest.mark.integration
+def test_titanic_walkthrough(stack, titanic_csv):
+    database = DatabaseApi()
+
+    result = database.create_file("titanic_train", titanic_csv, pretty_response=False)
+    assert result == {"result": "file_created"}
+    result = database.create_file("titanic_test", titanic_csv, pretty_response=False)
+    assert result == {"result": "file_created"}
+
+    projection = Projection()
+    fields = [
+        "PassengerId", "Survived", "Pclass", "Name", "Sex",
+        "Age", "SibSp", "Parch", "Fare", "Embarked",
+    ]
+    result = projection.create_projection(
+        "titanic_train", "train_proj", list(fields), pretty_response=False
+    )
+    assert result == {"result": "created_file"}
+    result = projection.create_projection(
+        "titanic_test", "test_proj", list(fields), pretty_response=False
+    )
+    assert result == {"result": "created_file"}
+
+    handler = DataTypeHandler()
+    numeric = {
+        f: "number"
+        for f in ("PassengerId", "Survived", "Pclass", "Age", "SibSp", "Parch", "Fare")
+    }
+    for name in ("train_proj", "test_proj"):
+        result = handler.change_file_type(name, dict(numeric), pretty_response=False)
+        assert result == {"result": "file_changed"}
+
+    histogram = Histogram()
+    result = histogram.create_histogram(
+        "train_proj", "train_hist", ["Sex", "Pclass"], pretty_response=False
+    )
+    assert result == {"result": "created_file"}
+    histogram_doc = next(stack.find("train_hist", {"_id": 1}))
+    assert {e["_id"]: e["count"] for e in histogram_doc["Sex"]} == {
+        "male": 5,
+        "female": 3,
+    }
+
+    model = Model()
+    result = model.create_model(
+        "train_proj",
+        "test_proj",
+        DOCUMENTED_PREPROCESSOR,
+        ["lr", "nb"],
+        pretty_response=False,
+    )
+    assert result == {"result": "created_file"}
+
+    for name in ("lr", "nb"):
+        collection = f"test_proj_prediction_{name}"
+        meta = stack.find_one(collection, {"_id": 0})
+        assert meta["classificator"] == name
+        assert float(meta["accuracy"]) >= 0
+        rows = database.read_file(collection, limit=10, pretty_response=False)
+        predictions = rows["result"][1:]
+        assert predictions and "prediction" in predictions[0]
+
+    pca = Pca()
+    result = pca.create_image_plot(
+        "train_pca", "train_proj", label_name="Sex", pretty_response=False
+    )
+    assert result == {"result": "created_file"}
+    filenames = pca.read_image_plot_filenames(pretty_response=False)
+    assert filenames == {"result": ["train_pca.png"]}
+
+    # error semantics through the client: 4xx raises with the message
+    with pytest.raises(Exception, match="duplicate_file"):
+        database.create_file("titanic_train", titanic_csv, pretty_response=False)
+
+
+@pytest.mark.integration
+def test_pretty_response_returns_json_string(stack, titanic_csv):
+    database = DatabaseApi()
+    listing = database.read_resume_files(pretty_response=True)
+    assert isinstance(listing, str)
+    assert "result" in json.loads(listing)

@@ -1,0 +1,256 @@
+"""REST services: route/status/error-string parity with the reference."""
+
+import json
+
+import pytest
+
+from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+from learningorchestra_tpu.core.jobs import JobManager
+from learningorchestra_tpu.services import (
+    data_type_handler,
+    database_api,
+    histogram,
+    images,
+    model_builder,
+    projection,
+)
+
+
+def body(response):
+    return json.loads(response.get_data())
+
+
+@pytest.fixture()
+def ingested(store, titanic_csv):
+    write_ingest_metadata(store, "titanic", titanic_csv)
+    ingest_csv(store, "titanic", titanic_csv)
+    return store
+
+
+class TestDatabaseApi:
+    def test_create_file_async_and_read(self, store, titanic_csv):
+        jobs = JobManager()
+        client = database_api.create_app(store, jobs).test_client()
+        response = client.post(
+            "/files", json={"filename": "titanic", "url": titanic_csv}
+        )
+        assert response.status_code == 201
+        assert body(response) == {"result": "file_created"}
+        jobs.wait("ingest:titanic", timeout=30)
+        response = client.get("/files/titanic?skip=0&limit=1&query={}")
+        assert response.status_code == 200
+        meta = body(response)["result"][0]
+        assert meta["finished"] is True and meta["filename"] == "titanic"
+
+    def test_invalid_url_406(self, store, tmp_path):
+        bad = tmp_path / "bad.html"
+        bad.write_text("<html></html>")
+        client = database_api.create_app(store).test_client()
+        response = client.post(
+            "/files", json={"filename": "x", "url": str(bad)}
+        )
+        assert response.status_code == 406
+        assert body(response) == {"result": "invalid_url"}
+
+    def test_duplicate_409(self, ingested, titanic_csv):
+        client = database_api.create_app(ingested).test_client()
+        response = client.post(
+            "/files", json={"filename": "titanic", "url": titanic_csv}
+        )
+        assert response.status_code == 409
+        assert body(response) == {"result": "duplicate_file"}
+
+    def test_pagination_cap_20(self, store, tmp_path):
+        csv = tmp_path / "wide.csv"
+        csv.write_text("a\n" + "\n".join(str(i) for i in range(50)))
+        jobs = JobManager()
+        client = database_api.create_app(store, jobs).test_client()
+        client.post("/files", json={"filename": "wide", "url": str(csv)})
+        jobs.wait("ingest:wide", timeout=30)
+        response = client.get("/files/wide?skip=0&limit=100&query={}")
+        assert len(body(response)["result"]) == 20
+
+    def test_read_resume_and_delete(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        listing = body(client.get("/files"))["result"]
+        assert listing and "_id" not in listing[0]
+        response = client.delete("/files/titanic")
+        assert response.status_code == 200
+        assert body(response) == {"result": "deleted_file"}
+        assert "titanic" not in ingested.list_collections()
+
+
+class TestProjection:
+    def test_created(self, ingested):
+        client = projection.create_app(ingested).test_client()
+        response = client.post(
+            "/projections/titanic",
+            json={"projection_filename": "proj", "fields": ["Name", "Age"]},
+        )
+        assert response.status_code == 201
+        assert body(response) == {"result": "created_file"}
+        assert ingested.is_finished("proj")
+
+    def test_duplicate_409(self, ingested):
+        client = projection.create_app(ingested).test_client()
+        response = client.post(
+            "/projections/titanic",
+            json={"projection_filename": "titanic", "fields": ["Name"]},
+        )
+        assert response.status_code == 409
+        assert body(response) == {"result": "duplicate_file"}
+
+    def test_invalid_parent_406(self, ingested):
+        client = projection.create_app(ingested).test_client()
+        response = client.post(
+            "/projections/nope",
+            json={"projection_filename": "p", "fields": ["Name"]},
+        )
+        assert response.status_code == 406
+        assert body(response) == {"result": "invalid_filename"}
+
+    def test_missing_and_invalid_fields_406(self, ingested):
+        client = projection.create_app(ingested).test_client()
+        response = client.post(
+            "/projections/titanic",
+            json={"projection_filename": "p", "fields": []},
+        )
+        assert body(response) == {"result": "missing_fields"}
+        assert response.status_code == 406
+        response = client.post(
+            "/projections/titanic",
+            json={"projection_filename": "p", "fields": ["Nope"]},
+        )
+        assert body(response) == {"result": "invalid_fields"}
+        assert response.status_code == 406
+
+
+class TestDataTypeHandler:
+    def test_changed(self, ingested):
+        client = data_type_handler.create_app(ingested).test_client()
+        response = client.patch("/fieldtypes/titanic", json={"Age": "number"})
+        assert response.status_code == 200
+        assert body(response) == {"result": "file_changed"}
+
+    def test_errors(self, ingested):
+        client = data_type_handler.create_app(ingested).test_client()
+        assert body(client.patch("/fieldtypes/nope", json={"Age": "number"})) == {
+            "result": "invalid_filename"
+        }
+        assert body(client.patch("/fieldtypes/titanic", json={})) == {
+            "result": "missing_fields"
+        }
+        assert body(
+            client.patch("/fieldtypes/titanic", json={"Age": "boolean"})
+        ) == {"result": "invalid_fields"}
+
+
+class TestHistogram:
+    def test_created(self, ingested):
+        client = histogram.create_app(ingested).test_client()
+        response = client.post(
+            "/histograms/titanic",
+            json={"histogram_filename": "hist", "fields": ["Sex"]},
+        )
+        assert response.status_code == 201
+        assert body(response) == {"result": "created_file"}
+
+    def test_duplicate_uses_histogram_string(self, ingested):
+        client = histogram.create_app(ingested).test_client()
+        response = client.post(
+            "/histograms/titanic",
+            json={"histogram_filename": "titanic", "fields": ["Sex"]},
+        )
+        assert response.status_code == 409
+        assert body(response) == {"result": "duplicated_filename"}
+
+
+class TestModelBuilder:
+    def test_validator_errors(self, ingested):
+        client = model_builder.create_app(ingested).test_client()
+        response = client.post(
+            "/models",
+            json={
+                "training_filename": "nope",
+                "test_filename": "titanic",
+                "preprocessor_code": "",
+                "classificators_list": ["lr"],
+            },
+        )
+        assert response.status_code == 406
+        assert body(response) == {"result": "invalid_training_filename"}
+        response = client.post(
+            "/models",
+            json={
+                "training_filename": "titanic",
+                "test_filename": "nope",
+                "preprocessor_code": "",
+                "classificators_list": ["lr"],
+            },
+        )
+        assert body(response) == {"result": "invalid_test_filename"}
+        response = client.post(
+            "/models",
+            json={
+                "training_filename": "titanic",
+                "test_filename": "titanic",
+                "preprocessor_code": "",
+                "classificators_list": ["svm"],
+            },
+        )
+        assert body(response) == {"result": "invalid_classificator_name"}
+
+
+class TestImagesService:
+    @pytest.fixture()
+    def numeric_store(self, store):
+        from learningorchestra_tpu.core.table import ColumnTable, write_table
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        table = ColumnTable.from_lists(
+            {
+                "a": rng.normal(size=40).tolist(),
+                "b": rng.normal(size=40).tolist(),
+                "Survived": rng.integers(0, 2, size=40).astype(float).tolist(),
+            }
+        )
+        write_table(
+            store,
+            "numbers",
+            table,
+            {"filename": "numbers", "finished": True, "fields": ["a", "b", "Survived"]},
+        )
+        return store
+
+    def test_pca_create_get_delete(self, numeric_store, tmp_path):
+        client = images.create_app(numeric_store, str(tmp_path), "pca").test_client()
+        response = client.post(
+            "/images/numbers",
+            json={"pca_filename": "img", "label_name": "Survived"},
+        )
+        assert response.status_code == 201
+        assert body(response) == {"result": "created_file"}
+        listing = body(client.get("/images"))["result"]
+        assert listing == ["img.png"]
+        response = client.get("/images/img")
+        assert response.status_code == 200
+        assert response.get_data()[:4] == b"\x89PNG"
+        response = client.post(
+            "/images/numbers", json={"pca_filename": "img", "label_name": None}
+        )
+        assert response.status_code == 409
+        assert body(response) == {"result": "duplicate_file"}
+        response = client.delete("/images/img")
+        assert response.status_code == 200
+        response = client.get("/images/img")
+        assert response.status_code == 404
+        assert body(response) == {"result": "file_not_found"}
+
+    def test_invalid_label_406(self, numeric_store, tmp_path):
+        client = images.create_app(numeric_store, str(tmp_path), "pca").test_client()
+        response = client.post(
+            "/images/numbers", json={"pca_filename": "i2", "label_name": "nope"}
+        )
+        assert response.status_code == 406
+        assert body(response) == {"result": "invalid_field"}
