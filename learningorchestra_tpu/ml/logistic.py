@@ -21,8 +21,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from learningorchestra_tpu.parallel.mesh import MODEL_AXIS, model_size
 from learningorchestra_tpu.ml.base import (
     FittedModel,
     infer_num_classes,
@@ -39,13 +40,8 @@ def _loss_fn(params, X, y, mask, l2):
     return data_term + 0.5 * l2 * (params["w"] ** 2).sum()
 
 
-@partial(jax.jit, static_argnames=("num_classes", "max_iter"))
-def _fit(X, y, mask, num_classes: int, max_iter: int, l2):
-    num_features = X.shape[1]
-    params = {
-        "w": jnp.zeros((num_features, num_classes), jnp.float32),
-        "b": jnp.zeros((num_classes,), jnp.float32),
-    }
+@partial(jax.jit, static_argnames=("max_iter",))
+def _fit(params, X, y, mask, max_iter: int, l2):
     loss = partial(_loss_fn, X=X, y=y, mask=mask, l2=l2)
     optimizer = optax.lbfgs()
     value_and_grad = optax.value_and_grad_from_state(loss)
@@ -112,11 +108,31 @@ class LogisticRegression:
         scale = np.where(std > 0, std, 1.0)
         X_std = (np.asarray(X) - mean) / scale
         X_dev, y_dev, mask = prepare_xy(X_std, y, self.mesh)
+        # Tensor parallelism: the class dimension of W/b is sharded over
+        # the mesh's model axis (init sharding propagates through the
+        # whole L-BFGS scan), so X @ W partitions its output columns and
+        # log_softmax's normalizer is the only model-axis collective.
+        num_features = X_std.shape[1]
+        # Replicate when classes don't divide the axis (NamedSharding
+        # needs even splits); the data axis still carries the rows.
+        shardable = num_classes % model_size(self.mesh) == 0
+        class_spec = P(None, MODEL_AXIS) if shardable else P()
+        bias_spec = P(MODEL_AXIS) if shardable else P()
+        params0 = {
+            "w": jax.device_put(
+                jnp.zeros((num_features, num_classes), jnp.float32),
+                NamedSharding(self.mesh, class_spec),
+            ),
+            "b": jax.device_put(
+                jnp.zeros((num_classes,), jnp.float32),
+                NamedSharding(self.mesh, bias_spec),
+            ),
+        }
         params, _ = _fit(
+            params0,
             X_dev,
             y_dev,
             mask.astype(jnp.float32),
-            num_classes=num_classes,
             max_iter=self.max_iter,
             l2=jnp.float32(self.reg_param),
         )

@@ -74,7 +74,12 @@ def _level_histograms(bins, node, channels, n_nodes: int, max_bins: int):
             .add(channels)
         )
 
-    hist = jax.vmap(per_feature, in_axes=1)(bins)        # (F, nodes*B, K)
+    # Sequential over features (lax.map), parallel over rows within each
+    # scatter. A vmap over features would broadcast `channels` into an
+    # (F, rows, K) operand — and under the forest's tree-vmap a
+    # (trees, F, rows, K) one, 160 GB at 1M rows — while the map keeps
+    # the transient at (rows, K) per step with identical results.
+    hist = jax.lax.map(per_feature, bins.T)              # (F, nodes*B, K)
     num_features = bins.shape[1]
     return hist.reshape(num_features, n_nodes, max_bins, num_channels).transpose(
         1, 0, 2, 3
