@@ -107,6 +107,10 @@ def ingest_csv(
     Flips the metadata to ``finished: true`` with the field list when the
     stream drains. Returns the row count.
     """
+    # Always the streaming path: memory is bounded at one batch
+    # regardless of file size, and it is tolerant of ragged rows. The
+    # native C++ parser serves the columnar ``ColumnTable.from_csv``
+    # route, where full materialization is inherent.
     with ExitStack() as stack:
         reader = _csv_rows(_open_text(url, stack))
         file_header = next(reader)

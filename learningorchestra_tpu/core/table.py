@@ -73,6 +73,16 @@ class ColumnTable:
         return cls({name: as_column(values) for name, values in raw.items()})
 
     @classmethod
+    def from_csv(cls, path: str) -> "ColumnTable":
+        """Direct CSV → columnar table (bypassing the document store):
+        the host-side loader feeding device transfer (SURVEY.md §2's
+        connector replacement). Uses the native C++ parser when built
+        (native/loader.py), Python otherwise."""
+        from learningorchestra_tpu.native.loader import read_csv_columns
+
+        return cls(read_csv_columns(path))
+
+    @classmethod
     def from_store(
         cls,
         store: DocumentStore,

@@ -1,0 +1,16 @@
+"""Native runtime components (C++), bound via ctypes.
+
+The compute path is JAX/XLA; the IO path around it is native, like the
+reference's (its data plane was the JVM mongo-spark connector,
+SURVEY.md §2). ``loader.py`` exposes the C++ columnar CSV parser with a
+pure-Python fallback, so the framework degrades gracefully on hosts
+without a toolchain.
+"""
+
+from learningorchestra_tpu.native.loader import (
+    NativeCsv,
+    native_available,
+    read_csv_columns,
+)
+
+__all__ = ["NativeCsv", "native_available", "read_csv_columns"]

@@ -1,0 +1,217 @@
+"""ctypes bindings for the native CSV loader, with lazy build + fallback.
+
+The shared object is compiled on first use with g++ (``-O3 -shared
+-fPIC``) into the package directory; hosts without a toolchain (or where
+the build fails) transparently fall back to the Python csv module with
+identical results — the native path is a performance feature, not a
+correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCE = os.path.join(_HERE, "csv_loader.cpp")
+_LIBRARY = os.path.join(_HERE, "_csv_loader.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SOURCE, "-o", _LIBRARY],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        _build_failed = True
+        return None
+    return _load(_LIBRARY)
+
+
+def _load(path: str) -> ctypes.CDLL:
+    lib = ctypes.CDLL(path)
+    lib.csv_open.restype = ctypes.c_void_p
+    lib.csv_open.argtypes = [ctypes.c_char_p]
+    lib.csv_close.argtypes = [ctypes.c_void_p]
+    lib.csv_num_rows.restype = ctypes.c_uint64
+    lib.csv_num_rows.argtypes = [ctypes.c_void_p]
+    lib.csv_num_cols.restype = ctypes.c_uint64
+    lib.csv_num_cols.argtypes = [ctypes.c_void_p]
+    lib.csv_cell.restype = ctypes.c_void_p
+    lib.csv_cell.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_longlong,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.csv_col_is_numeric.restype = ctypes.c_int
+    lib.csv_col_is_numeric.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.csv_fill_numeric.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.csv_col_string_bytes.restype = ctypes.c_uint64
+    lib.csv_col_string_bytes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.csv_fill_strings.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_uint64,
+        ctypes.c_char_p,
+    ]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is None and not _build_failed:
+            have_library = os.path.exists(_LIBRARY)
+            have_source = os.path.exists(_SOURCE)
+            if have_library and (
+                not have_source
+                or os.path.getmtime(_LIBRARY) >= os.path.getmtime(_SOURCE)
+            ):
+                # Prebuilt .so shipped without source: load it directly.
+                _lib = _load(_LIBRARY)
+            elif have_source:
+                _lib = _build()
+            else:
+                _build_failed = True
+        return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+class NativeCsv:
+    """A parsed CSV file: header, cells, columnar numeric extraction."""
+
+    def __init__(self, path: str):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native CSV loader unavailable")
+        self._lib = lib
+        self._handle = lib.csv_open(path.encode())
+        if not self._handle:
+            raise OSError(f"cannot parse CSV at {path!r}")
+        self.num_rows = lib.csv_num_rows(self._handle)
+        self.num_cols = lib.csv_num_cols(self._handle)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.csv_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "NativeCsv":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def cell(self, row: int, col: int) -> str:
+        """Cell text; ``row == -1`` reads the header."""
+        length = ctypes.c_uint32()
+        pointer = self._lib.csv_cell(self._handle, row, col, ctypes.byref(length))
+        if not pointer or length.value == 0:
+            return ""
+        return ctypes.string_at(pointer, length.value).decode("utf-8")
+
+    def header(self) -> list[str]:
+        return [self.cell(-1, j) for j in range(self.num_cols)]
+
+    def column_is_numeric(self, col: int) -> bool:
+        return bool(self._lib.csv_col_is_numeric(self._handle, col))
+
+    def numeric_column(self, col: int) -> np.ndarray:
+        out = np.empty(self.num_rows, dtype=np.float64)
+        self._lib.csv_fill_numeric(
+            self._handle,
+            col,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        return out
+
+    def string_column(self, col: int) -> np.ndarray:
+        """One bulk NUL-joined copy out of C, one decode, one split —
+        no per-cell ctypes round trips."""
+        total = self._lib.csv_col_string_bytes(self._handle, col)
+        buffer = ctypes.create_string_buffer(int(total))
+        self._lib.csv_fill_strings(self._handle, col, buffer)
+        cells = buffer.raw[: int(total)].decode("utf-8").split("\x00")
+        out = np.empty(self.num_rows, dtype=object)
+        out[:] = cells[: self.num_rows]
+        return out
+
+
+MAX_NUMERIC_CELL = 511  # both paths treat longer cells as strings
+
+
+def _python_read(path: str) -> dict[str, np.ndarray]:
+    import csv
+
+    with open(path, encoding="utf-8", newline="") as handle:
+        reader = csv.reader(handle)
+        header = next(reader)
+        rows = [row for row in reader if row]
+    columns: dict[str, np.ndarray] = {}
+    for j, name in enumerate(header):
+        raw = [row[j] if j < len(row) else "" for row in rows]
+        try:
+            if any(len(cell) > MAX_NUMERIC_CELL for cell in raw):
+                raise ValueError("oversized numeric cell")
+            columns[name] = np.array(
+                [np.nan if cell == "" else float(cell) for cell in raw],
+                dtype=np.float64,
+            )
+        except ValueError:
+            columns[name] = _strings_column(raw)
+    return columns
+
+
+def _strings_column(cells: list[str]) -> np.ndarray:
+    """Object column with the ColumnTable missing-value convention:
+    empty cells become None, not ''."""
+    out = np.empty(len(cells), dtype=object)
+    for i, cell in enumerate(cells):
+        out[i] = None if cell == "" else cell
+    return out
+
+
+def read_csv_columns(path: str) -> dict[str, np.ndarray]:
+    """CSV → columns: float64 (NaN for empty) where every cell parses as
+    a number, object strings otherwise. Native when available, Python
+    fallback with identical semantics."""
+    lib = _get_lib()
+    if lib is None:
+        return _python_read(path)
+    try:
+        parsed = NativeCsv(path)
+    except OSError:
+        # e.g. ragged-wide rows the strict native parser rejects — the
+        # tolerant Python path still handles them.
+        return _python_read(path)
+    with parsed:
+        header = parsed.header()
+        columns: dict[str, np.ndarray] = {}
+        for j, name in enumerate(header):
+            if parsed.column_is_numeric(j):
+                columns[name] = parsed.numeric_column(j)
+            else:
+                column = parsed.string_column(j)
+                column[column == ""] = None  # missing-value convention
+                columns[name] = column
+        return columns

@@ -1,0 +1,137 @@
+"""Native C++ CSV loader vs the Python fallback: identical semantics."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core.table import ColumnTable
+from learningorchestra_tpu.native.loader import (
+    NativeCsv,
+    _python_read,
+    native_available,
+    read_csv_columns,
+)
+
+CSV = (
+    'name,age,score,city\n'
+    '"Brown, Mr. A",22,7.25,NY\n'
+    '"Say ""hi""",35,,SF\n'
+    'plain,,9.5,LA\n'
+)
+
+
+@pytest.fixture()
+def csv_path(tmp_path):
+    path = tmp_path / "data.csv"
+    path.write_text(CSV)
+    return str(path)
+
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="g++ build unavailable"
+)
+
+
+@needs_native
+class TestNativeParser:
+    def test_dimensions_and_header(self, csv_path):
+        with NativeCsv(csv_path) as parsed:
+            assert parsed.num_rows == 3
+            assert parsed.num_cols == 4
+            assert parsed.header() == ["name", "age", "score", "city"]
+
+    def test_quoted_cells(self, csv_path):
+        with NativeCsv(csv_path) as parsed:
+            assert parsed.cell(0, 0) == "Brown, Mr. A"
+            assert parsed.cell(1, 0) == 'Say "hi"'
+
+    def test_numeric_detection_and_fill(self, csv_path):
+        with NativeCsv(csv_path) as parsed:
+            assert not parsed.column_is_numeric(0)
+            assert parsed.column_is_numeric(1)
+            assert parsed.column_is_numeric(2)
+            ages = parsed.numeric_column(1)
+            np.testing.assert_allclose(ages[:2], [22, 35])
+            assert np.isnan(ages[2])
+
+    def test_matches_python_fallback(self, csv_path):
+        native = read_csv_columns(csv_path)
+        python = _python_read(csv_path)
+        assert set(native) == set(python)
+        for name in native:
+            if native[name].dtype == object:
+                assert list(native[name]) == list(python[name])
+            else:
+                np.testing.assert_allclose(
+                    native[name], python[name], equal_nan=True
+                )
+
+    def test_crlf_and_trailing_newline(self, tmp_path):
+        path = tmp_path / "crlf.csv"
+        path.write_bytes(b"a,b\r\n1,x\r\n2,y\r\n")
+        with NativeCsv(str(path)) as parsed:
+            assert parsed.num_rows == 2
+            assert parsed.cell(1, 1) == "y"
+
+    def test_large_roundtrip(self, tmp_path, rng):
+        path = tmp_path / "big.csv"
+        values = rng.random(20_000)
+        with open(path, "w") as handle:
+            handle.write("x,tag\n")
+            for i, value in enumerate(values):
+                handle.write(f"{value:.17g},t{i % 7}\n")
+        columns = read_csv_columns(str(path))
+        np.testing.assert_allclose(columns["x"], values)
+        assert columns["tag"][13] == "t6"
+
+
+class TestFromCsv:
+    def test_column_table_from_csv(self, csv_path):
+        table = ColumnTable.from_csv(csv_path)
+        assert table.num_rows == 3
+        assert table.dtype_of("age") == "number"
+        assert table.dtype_of("name") == "string"
+
+    def test_ingest_native_path_matches_contract(self, store, csv_path):
+        from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+        from learningorchestra_tpu.core.store import ROW_ID
+
+        write_ingest_metadata(store, "d", csv_path)
+        n = ingest_csv(store, "d", csv_path)
+        assert n == 3
+        row = next(store.find("d", {ROW_ID: 1}))
+        # contract: values stay strings at ingest
+        assert row["age"] == "22" and row["name"] == "Brown, Mr. A"
+        meta = store.metadata("d")
+        assert meta["finished"] is True
+        assert meta["fields"] == ["name", "age", "score", "city"]
+
+
+class TestReviewRegressions:
+    def test_empty_strings_become_none(self, tmp_path):
+        path = tmp_path / "m.csv"
+        path.write_text("name,city\nBob,\nAmy,SF\n")
+        table = ColumnTable.from_csv(str(path))
+        assert table.columns["city"][0] is None
+        assert table.dropna().num_rows == 1
+
+    def test_hex_cells_stay_strings(self, tmp_path):
+        path = tmp_path / "h.csv"
+        path.write_text("x\n0x10\n0x20\n")
+        columns = read_csv_columns(str(path))
+        assert list(columns["x"]) == ["0x10", "0x20"]
+        assert list(columns["x"]) == list(_python_read(str(path))["x"])
+
+    def test_ragged_wide_falls_back(self, tmp_path):
+        path = tmp_path / "r.csv"
+        path.write_text("a,b\n1,2,3\n4,5\n")
+        columns = read_csv_columns(str(path))
+        assert len(columns["a"]) == 2
+
+    def test_ragged_ingest_still_streams(self, store, tmp_path):
+        from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+
+        path = tmp_path / "r.csv"
+        path.write_text("a,b\n1,2,3\n4,5\n")
+        write_ingest_metadata(store, "r", str(path))
+        assert ingest_csv(store, "r", str(path)) == 2
+        assert store.metadata("r")["finished"] is True
