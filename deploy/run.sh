@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Single-host bring-up — the analogue of the reference's `sudo ./run.sh`
+# (reference run.sh:1-108 builds seven images and `docker stack deploy`s
+# them around a MongoDB replica set; here one process serves all seven
+# APIs over a WAL-backed store, with JAX owning the accelerator).
+#
+# Usage:
+#   ./deploy/run.sh [data_dir]
+#
+# Environment:
+#   LO_HOST        bind address        (default 0.0.0.0)
+#   LO_DATA_DIR    store WAL directory (default ./lo_data, or $1)
+#   JAX_PLATFORMS  accelerator choice  (default: jax autodetect — TPU
+#                  when libtpu is present)
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export LO_DATA_DIR="${1:-${LO_DATA_DIR:-$PWD/lo_data}}"
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+exec python -m learningorchestra_tpu.services.runner

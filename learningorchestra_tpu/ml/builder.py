@@ -16,7 +16,6 @@ are written back in batched columnar writes, not 1 RPC per row.
 
 from __future__ import annotations
 
-import time
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Optional
 
@@ -29,6 +28,7 @@ from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
 from learningorchestra_tpu.ml.evaluation import accuracy_score, f1_score
+from learningorchestra_tpu.utils.profiling import PhaseTimer
 
 FEATURES_COL = "features"
 LABEL_COL = "label"
@@ -82,30 +82,34 @@ def train_one(
         "classificator": classificator_name,
         ROW_ID: 0,
     }
+    timer = PhaseTimer()
 
     X_train = features_training.feature_matrix(FEATURES_COL)
     y_train = features_training.label_vector(LABEL_COL)
 
     classifier = make_classifier(classificator_name, mesh=mesh)
-    fit_start = time.time()
-    model = classifier.fit(X_train, y_train)
-    metadata["fit_time"] = time.time() - fit_start
+    with timer.phase("fit"):
+        model = classifier.fit(X_train, y_train)
+    metadata["fit_time"] = timer.timings["fit"]
 
     if features_evaluation is not None:
         X_eval = features_evaluation.feature_matrix(FEATURES_COL)
         y_eval = features_evaluation.label_vector(LABEL_COL)
-        eval_pred = model.predict(X_eval)
-        # Stored as strings, matching the reference's metadata document
-        # (model_builder.py:223-224, values shown in docs/database_api.md).
-        metadata["F1"] = str(f1_score(y_eval, eval_pred))
-        metadata["accuracy"] = str(accuracy_score(y_eval, eval_pred))
+        with timer.phase("evaluate"):
+            eval_pred = model.predict(X_eval)
+            # Stored as strings, matching the reference's metadata document
+            # (model_builder.py:223-224, values shown in docs/database_api.md).
+            metadata["F1"] = str(f1_score(y_eval, eval_pred))
+            metadata["accuracy"] = str(accuracy_score(y_eval, eval_pred))
 
     X_test = features_testing.feature_matrix(FEATURES_COL)
-    prediction = model.predict(X_test)
-    probability = model.predict_proba(X_test)
+    with timer.phase("predict"):
+        prediction = model.predict(X_test)
+        probability = model.predict_proba(X_test)
     predicted_df = features_testing.withColumn(
         "prediction", prediction.astype(np.float64)
     ).withColumn("probability", probability)
+    metadata["timings"] = timer.as_metadata()
 
     # Written directly (not via write_documents): prediction metadata has
     # no ``finished`` flag in the reference either (model_builder.py:

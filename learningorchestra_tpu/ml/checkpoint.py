@@ -1,0 +1,123 @@
+"""Model checkpoint/resume.
+
+The reference never serializes models — only predictions and metrics
+survive a run, and a killed fit loses everything (reference:
+model_builder.py:232-247; SURVEY.md §5 "Checkpoint / resume: absent").
+This module adds what the reference lacks: every fitted model saves to
+one ``.npz`` (device arrays fetched to host) plus a JSON header typing
+it, and loads back into a predict-capable model on any host — TPU
+training, CPU serving included.
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Optional
+
+import numpy as np
+from jax.sharding import Mesh
+
+from learningorchestra_tpu.ml.base import resolve_mesh
+from learningorchestra_tpu.ml.logistic import LogisticRegressionModel
+from learningorchestra_tpu.ml.naive_bayes import NaiveBayesModel
+from learningorchestra_tpu.ml.trees import GBTModel, _TreeEnsembleModel
+
+_HEADER = "__model__.json"
+
+
+def _arrays_of(model) -> tuple[str, dict[str, np.ndarray], dict]:
+    if isinstance(model, LogisticRegressionModel):
+        return (
+            "logistic",
+            {
+                "w": np.asarray(model.params["w"]),
+                "b": np.asarray(model.params["b"]),
+                "mean": np.asarray(model.mean),
+                "scale": np.asarray(model.scale),
+            },
+            {},
+        )
+    if isinstance(model, NaiveBayesModel):
+        return (
+            "naive_bayes",
+            {"theta": np.asarray(model.theta), "prior": np.asarray(model.prior)},
+            {},
+        )
+    if isinstance(model, GBTModel):
+        return (
+            "gbt",
+            {
+                "features_heap": np.asarray(model.features_heap),
+                "thresholds_heap": np.asarray(model.thresholds_heap),
+                "leaf_values": np.asarray(model.leaf_values),
+            },
+            {
+                "f0": float(np.asarray(model.f0)),
+                "step": float(model.step),
+                "max_depth": int(model.max_depth),
+            },
+        )
+    if isinstance(model, _TreeEnsembleModel):
+        return (
+            "tree_ensemble",
+            {
+                "features_heap": np.asarray(model.features_heap),
+                "thresholds_heap": np.asarray(model.thresholds_heap),
+                "leaf_probs": np.asarray(model.leaf_probs),
+            },
+            {"max_depth": int(model.max_depth)},
+        )
+    raise TypeError(f"unknown model type {type(model).__name__}")
+
+
+def save_model(model, path: str) -> None:
+    """Write a fitted model to ``path`` (.npz format, any extension)."""
+    kind, arrays, scalars = _arrays_of(model)
+    # Write through a file object: np.savez given a *name* appends
+    # ".npz", which would split the archive from the header below.
+    with open(path, "wb") as handle:
+        np.savez(handle, **arrays)
+    header = json.dumps({"kind": kind, "scalars": scalars})
+    with zipfile.ZipFile(path, "a") as archive:
+        archive.writestr(_HEADER, header)
+
+
+def load_model(path: str, mesh: Optional[Mesh] = None):
+    """Load a model saved by :func:`save_model`; predict-ready."""
+    import jax.numpy as jnp
+
+    mesh = resolve_mesh(mesh)
+    with zipfile.ZipFile(path) as archive:
+        header = json.loads(archive.read(_HEADER))
+    data = np.load(path)
+    kind = header["kind"]
+    scalars = header["scalars"]
+    if kind == "logistic":
+        params = {"w": jnp.asarray(data["w"]), "b": jnp.asarray(data["b"])}
+        return LogisticRegressionModel(
+            params, jnp.asarray(data["mean"]), jnp.asarray(data["scale"]), mesh
+        )
+    if kind == "naive_bayes":
+        return NaiveBayesModel(
+            jnp.asarray(data["theta"]), jnp.asarray(data["prior"]), mesh
+        )
+    if kind == "gbt":
+        return GBTModel(
+            jnp.float32(scalars["f0"]),
+            jnp.asarray(data["features_heap"]),
+            jnp.asarray(data["thresholds_heap"]),
+            jnp.asarray(data["leaf_values"]),
+            scalars["step"],
+            mesh,
+            scalars["max_depth"],
+        )
+    if kind == "tree_ensemble":
+        return _TreeEnsembleModel(
+            jnp.asarray(data["features_heap"]),
+            jnp.asarray(data["thresholds_heap"]),
+            jnp.asarray(data["leaf_probs"]),
+            mesh,
+            scalars["max_depth"],
+        )
+    raise ValueError(f"unknown checkpoint kind {kind!r}")

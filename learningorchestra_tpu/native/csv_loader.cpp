@@ -72,7 +72,8 @@ bool parse(CsvFile* f) {
     }
     row.push_back(cell);
     if (i >= n) {
-      bool empty_tail = row.size() == 1 && row[0].length == 0;
+      bool empty_tail =
+          row.size() == 1 && row[0].length == 0 && !row[0].quoted;
       if (!empty_tail) {
         if (first_row) { f->num_cols = row.size(); first_row = false; }
         else ++f->num_rows;

@@ -152,6 +152,13 @@ class NativeCsv:
         buffer = ctypes.create_string_buffer(int(total))
         self._lib.csv_fill_strings(self._handle, col, buffer)
         cells = buffer.raw[: int(total)].decode("utf-8").split("\x00")
+        if len(cells) != self.num_rows + 1:
+            # a cell contained a literal NUL: the separator protocol
+            # over-splits — take the exact per-cell path instead.
+            out = np.empty(self.num_rows, dtype=object)
+            for i in range(self.num_rows):
+                out[i] = self.cell(i, col)
+            return out
         out = np.empty(self.num_rows, dtype=object)
         out[:] = cells[: self.num_rows]
         return out
@@ -171,8 +178,13 @@ def _python_read(path: str) -> dict[str, np.ndarray]:
     for j, name in enumerate(header):
         raw = [row[j] if j < len(row) else "" for row in rows]
         try:
-            if any(len(cell) > MAX_NUMERIC_CELL for cell in raw):
-                raise ValueError("oversized numeric cell")
+            # Reject what strtod rejects so both paths agree: oversized
+            # cells, underscore separators ("1_000"), non-ASCII digits.
+            if any(
+                len(cell) > MAX_NUMERIC_CELL or "_" in cell or not cell.isascii()
+                for cell in raw
+            ):
+                raise ValueError("cell outside the shared numeric grammar")
             columns[name] = np.array(
                 [np.nan if cell == "" else float(cell) for cell in raw],
                 dtype=np.float64,

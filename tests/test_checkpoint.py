@@ -1,0 +1,85 @@
+"""Checkpoint/resume: every fitted model round-trips through disk."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.ml.base import make_classifier
+from learningorchestra_tpu.ml.checkpoint import load_model, save_model
+from learningorchestra_tpu.utils.profiling import PhaseTimer
+
+
+@pytest.fixture()
+def data(rng):
+    X = rng.normal(size=(300, 5))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(int)
+    return X, y
+
+
+class TestCheckpoint:
+    @pytest.mark.parametrize("name", ["lr", "nb", "dt", "rf", "gb"])
+    def test_roundtrip_predictions_identical(self, name, data, tmp_path):
+        X, y = data
+        X_fit = np.abs(X) if name == "nb" else X
+        model = make_classifier(name).fit(X_fit, y)
+        path = str(tmp_path / f"{name}.npz")
+        save_model(model, path)
+        restored = load_model(path)
+        np.testing.assert_array_equal(
+            model.predict(X_fit), restored.predict(X_fit)
+        )
+        np.testing.assert_allclose(
+            model.predict_proba(X_fit), restored.predict_proba(X_fit), atol=1e-6
+        )
+
+    def test_unknown_type_raises(self, tmp_path):
+        with pytest.raises(TypeError):
+            save_model(object(), str(tmp_path / "x.npz"))
+
+
+class TestPhaseTimer:
+    def test_accumulates(self):
+        timer = PhaseTimer()
+        with timer.phase("a"):
+            pass
+        with timer.phase("a"):
+            pass
+        with timer.phase("b"):
+            pass
+        assert set(timer.timings) == {"a", "b"}
+        assert timer.as_metadata()["a"] >= 0
+
+    def test_builder_records_timings(self, store, titanic_csv):
+        from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+        from learningorchestra_tpu.ml.builder import build_model
+        from learningorchestra_tpu.ops.dtype import convert_field_types
+        from tests.test_frame import DOCUMENTED_PREPROCESSOR
+
+        for name in ("t_train", "t_test"):
+            write_ingest_metadata(store, name, titanic_csv)
+            ingest_csv(store, name, titanic_csv)
+            convert_field_types(
+                store,
+                name,
+                {
+                    f: "number"
+                    for f in (
+                        "PassengerId", "Survived", "Pclass", "Age",
+                        "SibSp", "Parch", "Fare",
+                    )
+                },
+            )
+        results = build_model(
+            store, "t_train", "t_test", DOCUMENTED_PREPROCESSOR, ["nb"]
+        )
+        timings = results[0]["timings"]
+        assert {"fit", "evaluate", "predict"} <= set(timings)
+
+    def test_roundtrip_with_non_npz_extension(self, data, tmp_path):
+        X, y = data
+        model = make_classifier("nb").fit(np.abs(X), y)
+        path = str(tmp_path / "model.ckpt")
+        save_model(model, path)
+        restored = load_model(path)
+        np.testing.assert_array_equal(
+            model.predict(np.abs(X)), restored.predict(np.abs(X))
+        )

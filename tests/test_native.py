@@ -135,3 +135,10 @@ class TestReviewRegressions:
         write_ingest_metadata(store, "r", str(path))
         assert ingest_csv(store, "r", str(path)) == 2
         assert store.metadata("r")["finished"] is True
+
+    def test_underscore_cells_stay_strings(self, tmp_path):
+        path = tmp_path / "u.csv"
+        path.write_text("x\n1_000\n2_000\n")
+        columns = read_csv_columns(str(path))
+        assert list(columns["x"]) == ["1_000", "2_000"]
+        assert list(columns["x"]) == list(_python_read(str(path))["x"])
