@@ -8,7 +8,9 @@
 #   ./deploy/run.sh [data_dir]
 #
 # Environment:
-#   LO_HOST        bind address        (default 0.0.0.0)
+#   LO_HOST        bind address (default 127.0.0.1; set 0.0.0.0 to expose
+#                  beyond localhost — model_builder executes request-
+#                  supplied code, so only do that inside a sandbox)
 #   LO_DATA_DIR    store WAL directory (default ./lo_data, or $1)
 #   JAX_PLATFORMS  accelerator choice  (default: jax autodetect — TPU
 #                  when libtpu is present)

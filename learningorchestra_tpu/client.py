@@ -1,14 +1,18 @@
-"""Python client — API-compatible with `learning_orchestra_client` 1.0.1.
+"""Python client — API-compatible with ``learning_orchestra_client`` 1.0.1.
 
-Reference: learning_orchestra_client/learning_orchestra_client/
-__init__.py:1-370. Same classes (``Context``, ``DatabaseApi``,
-``Projection``, ``DataTypeHandler``, ``Histogram``, ``Tsne``, ``Pca``,
-``Model``), same method signatures, same hard-coded service ports, same
-poll-until-``finished`` synchronization (3 s interval,
-``AsyncronousWait``) and the same ``ResponseTreat`` semantics (pretty
-JSON string by default, raise on 4xx, raw text on 5xx). A user script
-written against the reference client runs against this framework by
-changing only the import.
+Drop-in compatibility contract (reference:
+learning_orchestra_client/learning_orchestra_client/__init__.py:1-370):
+the class names (including the reference's ``AsyncronousWait`` spelling),
+method signatures, hard-coded service ports, poll-until-``finished``
+synchronization, ``ResponseTreat`` semantics (pretty JSON string by
+default, raise on 4xx, raw text on 5xx), **and the printed banner lines**
+— output parity is intended, so the banner texts below reproduce the
+reference's exact strings, typos included (``READE``, ``HTTP_SUCESS``).
+A user script written against the reference client runs against this
+framework by changing only the import.
+
+The implementation is original: one ``_RestClient`` base owns the HTTP
+plumbing and banner printing that the reference repeats per class.
 """
 
 from __future__ import annotations
@@ -27,280 +31,277 @@ class Context:
         cluster_url = "http://" + ip_from_cluster
 
 
+def _banner(body: str) -> None:
+    """The reference's section separator: ``\\n----------<body>----------``."""
+    print("\n----------" + body + "----------")
+
+
+class ResponseTreat:
+    HTTP_CREATED = 201
+    HTTP_SUCESS = 200  # reference constant name, typo intended
+    HTTP_ERROR = 500
+
+    def treatment(self, response, pretty_response: bool = True):
+        ok_codes = (self.HTTP_SUCESS, self.HTTP_CREATED)
+        if response.status_code >= self.HTTP_ERROR:
+            return response.text
+        if response.status_code not in ok_codes:
+            raise Exception(response.json()["result"])
+        if pretty_response:
+            return json.dumps(response.json(), indent=2)
+        return response.json()
+
+
 class AsyncronousWait:
     WAIT_TIME = 3
     METADATA_INDEX = 0
 
     def wait(self, filename: str, pretty_response: bool = True) -> None:
         if pretty_response:
-            print(
-                "\n----------" + " WAITING " + filename + " FINISH " + "----------"
-            )
-        database_api = DatabaseApi()
+            _banner(" WAITING " + filename + " FINISH ")
+        reader = DatabaseApi()
         while True:
             time.sleep(self.WAIT_TIME)
-            response = database_api.read_file(
-                filename, limit=1, pretty_response=False
-            )
-            if len(response["result"]) == 0:
-                continue
-            if response["result"][self.METADATA_INDEX]["finished"]:
-                break
+            listing = reader.read_file(filename, limit=1, pretty_response=False)
+            rows = listing["result"]
+            if rows and rows[self.METADATA_INDEX]["finished"]:
+                return
 
 
-class ResponseTreat:
-    HTTP_CREATED = 201
-    HTTP_SUCESS = 200
-    HTTP_ERROR = 500
+class _RestClient:
+    """Shared plumbing for every service wrapper: URL construction from
+    the per-class port constant, banner printing, request dispatch, and
+    the poll-before-submit idiom (mutating calls first wait for their
+    input dataset's ``finished`` flag)."""
 
-    def treatment(self, response, pretty_response: bool = True):
-        if response.status_code >= self.HTTP_ERROR:
-            return response.text
-        elif response.status_code not in (self.HTTP_SUCESS, self.HTTP_CREATED):
-            raise Exception(response.json()["result"])
-        elif pretty_response:
-            return json.dumps(response.json(), indent=2)
-        else:
-            return response.json()
+    _RESOURCE = ""
+
+    def __init__(self, port: str):
+        global cluster_url
+        self.url_base = f"{cluster_url}:{port}/{self._RESOURCE}"
+        self.asyncronous_wait = AsyncronousWait()
+
+    # --- request helpers ------------------------------------------------------
+    def _url(self, suffix: str = "") -> str:
+        return self.url_base + ("/" + suffix if suffix else "")
+
+    def _treat(self, response, pretty_response: bool):
+        return ResponseTreat().treatment(response, pretty_response)
+
+    def _get(self, suffix: str = "", params=None, pretty_response: bool = True):
+        return self._treat(
+            requests.get(url=self._url(suffix), params=params), pretty_response
+        )
+
+    def _post(self, suffix: str = "", body=None, pretty_response: bool = True):
+        return self._treat(
+            requests.post(url=self._url(suffix), json=body), pretty_response
+        )
+
+    def _patch(self, suffix: str = "", body=None, pretty_response: bool = True):
+        return self._treat(
+            requests.patch(url=self._url(suffix), json=body), pretty_response
+        )
+
+    def _delete(self, suffix: str = "", pretty_response: bool = True):
+        return self._treat(requests.delete(url=self._url(suffix)), pretty_response)
+
+    def _wait_finished(self, filename: str, pretty_response: bool) -> None:
+        self.asyncronous_wait.wait(filename, pretty_response)
 
 
-class DatabaseApi:
+class DatabaseApi(_RestClient):
     DATABASE_API_PORT = "5000"
+    _RESOURCE = "files"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = cluster_url + ":" + self.DATABASE_API_PORT + "/files"
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.DATABASE_API_PORT)
 
     def read_resume_files(self, pretty_response: bool = True):
         if pretty_response:
-            print("\n----------" + " READ RESUME FILES " + "----------")
-        return ResponseTreat().treatment(requests.get(self.url_base), pretty_response)
+            _banner(" READ RESUME FILES ")
+        return self._get(pretty_response=pretty_response)
 
     def read_file(
         self, filename, skip=0, limit=10, query={}, pretty_response: bool = True
     ):
         if pretty_response:
-            print("\n----------" + " READ FILE " + filename + " ----------")
-        request_params = {"skip": str(skip), "limit": str(limit), "query": str(query)}
-        response = requests.get(
-            url=self.url_base + "/" + filename, params=request_params
-        )
-        return ResponseTreat().treatment(response, pretty_response)
+            _banner(" READ FILE " + filename + " ")
+        params = {"skip": str(skip), "limit": str(limit), "query": str(query)}
+        return self._get(filename, params=params, pretty_response=pretty_response)
 
     def create_file(self, filename, url, pretty_response: bool = True):
         if pretty_response:
-            print("\n----------" + " CREATE FILE " + filename + " ----------")
-        response = requests.post(
-            url=self.url_base, json={"filename": filename, "url": url}
-        )
-        return ResponseTreat().treatment(response, pretty_response)
+            _banner(" CREATE FILE " + filename + " ")
+        body = {"filename": filename, "url": url}
+        return self._post(body=body, pretty_response=pretty_response)
 
     def delete_file(self, filename, pretty_response: bool = True):
         if pretty_response:
-            print("\n----------" + " DELETE FILE " + filename + " ----------")
-        self.asyncronous_wait.wait(filename, pretty_response)
-        response = requests.delete(url=self.url_base + "/" + filename)
-        return ResponseTreat().treatment(response, pretty_response)
+            _banner(" DELETE FILE " + filename + " ")
+        self._wait_finished(filename, pretty_response)
+        return self._delete(filename, pretty_response=pretty_response)
 
 
-class Projection:
+class Projection(_RestClient):
     PROJECTION_PORT = "5001"
+    _RESOURCE = "projections"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = cluster_url + ":" + self.PROJECTION_PORT + "/projections"
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.PROJECTION_PORT)
 
     def create_projection(
         self, filename, projection_filename, fields, pretty_response: bool = True
     ):
         if pretty_response:
-            print(
-                "\n----------"
-                + " CREATE PROJECTION FROM "
+            _banner(
+                " CREATE PROJECTION FROM "
                 + filename
                 + " TO "
                 + projection_filename
-                + " ----------"
+                + " "
             )
-        self.asyncronous_wait.wait(filename, pretty_response)
-        response = requests.post(
-            url=self.url_base + "/" + filename,
-            json={"projection_filename": projection_filename, "fields": fields},
-        )
-        return ResponseTreat().treatment(response, pretty_response)
+        self._wait_finished(filename, pretty_response)
+        body = {"projection_filename": projection_filename, "fields": fields}
+        return self._post(filename, body=body, pretty_response=pretty_response)
 
 
-class Histogram:
+class Histogram(_RestClient):
     HISTOGRAM_PORT = "5004"
+    _RESOURCE = "histograms"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = cluster_url + ":" + self.HISTOGRAM_PORT + "/histograms"
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.HISTOGRAM_PORT)
 
     def create_histogram(
         self, filename, histogram_filename, fields, pretty_response: bool = True
     ):
         if pretty_response:
-            print(
-                "\n----------"
-                + " CREATE HISTOGRAM FROM "
+            _banner(
+                " CREATE HISTOGRAM FROM "
                 + filename
                 + " TO "
                 + histogram_filename
-                + " ----------"
+                + " "
             )
-        self.asyncronous_wait.wait(filename, pretty_response)
-        response = requests.post(
-            url=self.url_base + "/" + filename,
-            json={"histogram_filename": histogram_filename, "fields": fields},
-        )
-        return ResponseTreat().treatment(response, pretty_response)
+        self._wait_finished(filename, pretty_response)
+        body = {"histogram_filename": histogram_filename, "fields": fields}
+        return self._post(filename, body=body, pretty_response=pretty_response)
 
 
-class Tsne:
+class _ImagePlots(_RestClient):
+    """Common body of the reference's near-identical ``Tsne``/``Pca``
+    classes; ``_METHOD_LABEL`` and ``_FILENAME_KEY`` carry the two
+    differences (banner wording and request key)."""
+
+    _RESOURCE = "images"
+    _METHOD_LABEL = ""
+    _FILENAME_KEY = ""
+
+    def _create_image_plot(
+        self, output_filename, parent_filename, label_name, pretty_response
+    ):
+        if pretty_response:
+            _banner(
+                " CREATE "
+                + self._METHOD_LABEL
+                + " IMAGE PLOT FROM "
+                + parent_filename
+                + " TO "
+                + output_filename
+                + " "
+            )
+        self._wait_finished(parent_filename, pretty_response)
+        body = {self._FILENAME_KEY: output_filename, "label_name": label_name}
+        return self._post(parent_filename, body=body, pretty_response=pretty_response)
+
+    def _delete_image_plot(self, output_filename, pretty_response, trailing: str):
+        if pretty_response:
+            _banner(" DELETE " + output_filename + trailing)
+        return self._delete(output_filename, pretty_response=pretty_response)
+
+    def read_image_plot_filenames(self, pretty_response=True):
+        if pretty_response:
+            _banner(" READE IMAGE PLOT FILENAMES  ")  # reference typo
+        return self._get(pretty_response=pretty_response)
+
+    def _read_image_plot(self, output_filename, pretty_response):
+        if pretty_response:
+            _banner(
+                " READ " + output_filename + " " + self._METHOD_LABEL + " IMAGE PLOT "
+            )
+        return self._url(output_filename)
+
+
+class Tsne(_ImagePlots):
     TSNE_PORT = "5005"
+    _METHOD_LABEL = "t-SNE"
+    _FILENAME_KEY = "tsne_filename"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = cluster_url + ":" + self.TSNE_PORT + "/images"
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.TSNE_PORT)
 
     def create_image_plot(
         self, tsne_filename, parent_filename, label_name=None, pretty_response=True
     ):
-        if pretty_response:
-            print(
-                "\n----------"
-                + " CREATE t-SNE IMAGE PLOT FROM "
-                + parent_filename
-                + " TO "
-                + tsne_filename
-                + " ----------"
-            )
-        self.asyncronous_wait.wait(parent_filename, pretty_response)
-        response = requests.post(
-            url=self.url_base + "/" + parent_filename,
-            json={"tsne_filename": tsne_filename, "label_name": label_name},
+        return self._create_image_plot(
+            tsne_filename, parent_filename, label_name, pretty_response
         )
-        return ResponseTreat().treatment(response, pretty_response)
 
     def delete_image_plot(self, tsne_filename, pretty_response=True):
-        if pretty_response:
-            print(
-                "\n----------"
-                + " DELETE "
-                + tsne_filename
-                + "  t-SNE IMAGE PLOT "
-                + "----------"
-            )
-        response = requests.delete(url=self.url_base + "/" + tsne_filename)
-        return ResponseTreat().treatment(response, pretty_response)
-
-    def read_image_plot_filenames(self, pretty_response=True):
-        if pretty_response:
-            print("\n---------- READE IMAGE PLOT FILENAMES " + " ----------")
-        return ResponseTreat().treatment(requests.get(self.url_base), pretty_response)
+        # reference banner has two spaces before "t-SNE" here
+        return self._delete_image_plot(
+            tsne_filename, pretty_response, "  t-SNE IMAGE PLOT "
+        )
 
     def read_image_plot(self, tsne_filename, pretty_response=True):
-        if pretty_response:
-            print(
-                "\n----------"
-                + " READ "
-                + tsne_filename
-                + " t-SNE IMAGE PLOT "
-                + "----------"
-            )
-        return self.url_base + "/" + tsne_filename
+        return self._read_image_plot(tsne_filename, pretty_response)
 
 
-class Pca:
+class Pca(_ImagePlots):
     PCA_PORT = "5006"
+    _METHOD_LABEL = "PCA"
+    _FILENAME_KEY = "pca_filename"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = cluster_url + ":" + self.PCA_PORT + "/images"
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.PCA_PORT)
 
     def create_image_plot(
         self, pca_filename, parent_filename, label_name=None, pretty_response=True
     ):
-        if pretty_response:
-            print(
-                "\n----------"
-                + " CREATE PCA IMAGE PLOT FROM "
-                + parent_filename
-                + " TO "
-                + pca_filename
-                + " ----------"
-            )
-        self.asyncronous_wait.wait(parent_filename, pretty_response)
-        response = requests.post(
-            url=self.url_base + "/" + parent_filename,
-            json={"pca_filename": pca_filename, "label_name": label_name},
+        return self._create_image_plot(
+            pca_filename, parent_filename, label_name, pretty_response
         )
-        return ResponseTreat().treatment(response, pretty_response)
 
     def delete_image_plot(self, pca_filename, pretty_response=True):
-        if pretty_response:
-            print(
-                "\n----------"
-                + " DELETE "
-                + pca_filename
-                + " PCA IMAGE PLOT "
-                + "----------"
-            )
-        response = requests.delete(url=self.url_base + "/" + pca_filename)
-        return ResponseTreat().treatment(response, pretty_response)
-
-    def read_image_plot_filenames(self, pretty_response=True):
-        if pretty_response:
-            print("\n---------- READE IMAGE PLOT FILENAMES " + " ----------")
-        return ResponseTreat().treatment(requests.get(self.url_base), pretty_response)
+        return self._delete_image_plot(
+            pca_filename, pretty_response, " PCA IMAGE PLOT "
+        )
 
     def read_image_plot(self, pca_filename, pretty_response=True):
-        if pretty_response:
-            print(
-                "\n----------"
-                + " READ "
-                + pca_filename
-                + " PCA IMAGE PLOT "
-                + "----------"
-            )
-        return self.url_base + "/" + pca_filename
+        return self._read_image_plot(pca_filename, pretty_response)
 
 
-class DataTypeHandler:
+class DataTypeHandler(_RestClient):
     DATA_TYPE_HANDLER_PORT = "5003"
+    _RESOURCE = "fieldtypes"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = (
-            cluster_url + ":" + self.DATA_TYPE_HANDLER_PORT + "/fieldtypes"
-        )
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.DATA_TYPE_HANDLER_PORT)
 
     def change_file_type(self, filename, fields_dict, pretty_response: bool = True):
         if pretty_response:
-            print(
-                "\n----------" + " CHANGE " + filename + " FILE TYPE " + "----------"
-            )
-        self.asyncronous_wait.wait(filename, pretty_response)
-        response = requests.patch(
-            url=self.url_base + "/" + filename, json=fields_dict
-        )
-        return ResponseTreat().treatment(response, pretty_response)
+            _banner(" CHANGE " + filename + " FILE TYPE ")
+        self._wait_finished(filename, pretty_response)
+        return self._patch(filename, body=fields_dict, pretty_response=pretty_response)
 
 
-class Model:
+class Model(_RestClient):
     MODEL_BUILDER_PORT = "5002"
+    _RESOURCE = "models"
 
     def __init__(self):
-        global cluster_url
-        self.url_base = cluster_url + ":" + self.MODEL_BUILDER_PORT + "/models"
-        self.asyncronous_wait = AsyncronousWait()
+        super().__init__(self.MODEL_BUILDER_PORT)
 
     def create_model(
         self,
@@ -311,23 +312,19 @@ class Model:
         pretty_response: bool = True,
     ):
         if pretty_response:
-            print(
-                "\n----------"
-                + " CREATE MODEL WITH "
+            _banner(
+                " CREATE MODEL WITH "
                 + training_filename
                 + " AND "
                 + test_filename
-                + " ----------"
+                + " "
             )
-        self.asyncronous_wait.wait(training_filename, pretty_response)
-        self.asyncronous_wait.wait(test_filename, pretty_response)
-        response = requests.post(
-            url=self.url_base,
-            json={
-                "training_filename": training_filename,
-                "test_filename": test_filename,
-                "preprocessor_code": preprocessor_code,
-                "classificators_list": model_classificator,
-            },
-        )
-        return ResponseTreat().treatment(response, pretty_response)
+        self._wait_finished(training_filename, pretty_response)
+        self._wait_finished(test_filename, pretty_response)
+        body = {
+            "training_filename": training_filename,
+            "test_filename": test_filename,
+            "preprocessor_code": preprocessor_code,
+            "classificators_list": model_classificator,
+        }
+        return self._post(body=body, pretty_response=pretty_response)

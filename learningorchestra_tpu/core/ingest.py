@@ -80,7 +80,13 @@ def timestamp() -> str:
 
 def write_ingest_metadata(store: DocumentStore, filename: str, url: str) -> None:
     """The up-front ``finished: false`` metadata document (reference:
-    database.py:205-213). Raises on duplicate collection."""
+    database.py:205-213). Raises KeyError on duplicate collection.
+
+    The duplicate gate is the same atomic ``create_collection`` claim the
+    create routes use, so an ingest can never share a collection with a
+    concurrently created projection/histogram output."""
+    if not store.create_collection(filename):
+        raise KeyError(f"collection {filename!r} already exists")
     store.insert_one(
         filename,
         {

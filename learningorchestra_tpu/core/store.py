@@ -28,6 +28,7 @@ from __future__ import annotations
 import ast
 import json
 import os
+import re
 import threading
 from typing import Any, Iterator, Optional
 
@@ -59,15 +60,115 @@ def parse_query(raw: Optional[str]) -> dict:
     if not raw:
         return {}
     try:
-        return json.loads(raw)
+        query = json.loads(raw)
     except (json.JSONDecodeError, ValueError):
-        return ast.literal_eval(raw)
+        query = ast.literal_eval(raw)  # ValueError/SyntaxError to caller
+    if not isinstance(query, dict):
+        raise ValueError(f"query must be a dict, got {type(query).__name__}")
+    return query
+
+
+class UnsupportedQueryError(ValueError):
+    """A query uses an operator this engine doesn't implement, or a
+    malformed operand. The REST layer maps it to a 400 rather than
+    letting it surface as a 500."""
+
+
+def _membership_list(op: str, operand: Any) -> Any:
+    if not isinstance(operand, (list, tuple, set)):
+        raise UnsupportedQueryError(f"{op} operand must be a list")
+    return operand
+
+
+def _compare(op: str, value: Any, operand: Any) -> bool:
+    if op == "$in":
+        operand = _membership_list(op, operand)
+    try:
+        if op == "$eq":
+            return value == operand
+        if op == "$gt":
+            return value > operand
+        if op == "$gte":
+            return value >= operand
+        if op == "$lt":
+            return value < operand
+        if op == "$lte":
+            return value <= operand
+        if op == "$in":
+            return value in operand
+    except TypeError:  # e.g. None vs number — Mongo treats as no match
+        return False
+    raise UnsupportedQueryError(f"unsupported query operator {op!r}")
+
+
+def _match_operators(document: dict, key: str, ops: dict) -> bool:
+    """Operator document on one field, with Mongo's missing-field
+    semantics: ``$ne``/``$nin`` match documents lacking the field, the
+    comparisons don't."""
+    present = key in document
+    value = document.get(key)
+    for op, operand in ops.items():
+        if op == "$exists":
+            if present != bool(operand):
+                return False
+        elif op == "$ne":
+            if present and value == operand:
+                return False
+        elif op == "$nin":
+            operand = _membership_list(op, operand)  # validate even if absent
+            if present and value in operand:
+                return False
+        elif op == "$regex":
+            try:
+                pattern = re.compile(operand)
+            except (re.error, TypeError) as error:
+                raise UnsupportedQueryError(
+                    f"invalid $regex operand {operand!r}"
+                ) from error
+            if not present or not isinstance(value, str) or not pattern.search(value):
+                return False
+        elif op == "$not":
+            if not isinstance(operand, dict):
+                raise UnsupportedQueryError("$not operand must be an operator dict")
+            if _match_operators(document, key, operand):
+                return False
+        else:
+            if op == "$in":
+                operand = _membership_list(op, operand)  # validate even if absent
+            if not present or not _compare(op, value, operand):
+                return False
+    return True
 
 
 def matches(document: dict, query: dict) -> bool:
-    """Mongo-style subset equality: every query pair must match."""
-    for key, value in query.items():
-        if key not in document or document[key] != value:
+    """Mongo-style match — the operator surface the reference exposes by
+    forwarding client queries straight to pymongo ``find`` (reference:
+    microservices/database_api_image/database.py:36-44): subset equality,
+    ``$eq/$ne/$gt/$gte/$lt/$lte/$in/$nin/$exists/$regex/$not``, and the
+    top-level logicals ``$or/$and/$nor``. Anything else raises
+    :class:`UnsupportedQueryError` (→ REST 400) instead of silently
+    matching nothing."""
+    for key, condition in query.items():
+        if key in ("$or", "$and", "$nor"):
+            if not isinstance(condition, (list, tuple)) or not all(
+                isinstance(sub, dict) for sub in condition
+            ):
+                raise UnsupportedQueryError(f"{key} operand must be a list of dicts")
+            branches = [matches(document, sub) for sub in condition]
+            if key == "$or" and not any(branches):
+                return False
+            if key == "$and" and not all(branches):
+                return False
+            if key == "$nor" and any(branches):
+                return False
+        elif key.startswith("$"):
+            raise UnsupportedQueryError(f"unsupported query operator {key!r}")
+        elif isinstance(condition, dict) and any(
+            k.startswith("$") for k in condition
+        ):
+            if not _match_operators(document, key, condition):
+                return False
+        elif key not in document or document[key] != condition:
             return False
     return True
 
@@ -77,6 +178,17 @@ class DocumentStore:
 
     # --- collection lifecycle -------------------------------------------------
     def list_collections(self) -> list[str]:
+        raise NotImplementedError
+
+    def create_collection(self, collection: str) -> bool:
+        """Atomically claim ``collection``; False if it already exists.
+
+        The duplicate-output-name gate for create routes. The reference
+        validates with a check-then-act list scan
+        (reference: microservices/projection_image/projection.py:151-155)
+        — a race SURVEY §5 flags; this primitive makes the claim atomic
+        so concurrent duplicate creates get exactly one winner.
+        """
         raise NotImplementedError
 
     def drop(self, collection: str) -> None:
@@ -223,6 +335,8 @@ class InMemoryStore(DocumentStore):
                     self._apply_set_field(
                         record["c"], record["f"], dict(record["d"])
                     )
+                elif op == "create":
+                    self._collections.setdefault(record["c"], {})
                 elif op == "drop":
                     self._collections.pop(record["c"], None)
 
@@ -234,12 +348,14 @@ class InMemoryStore(DocumentStore):
             self._wal.close()
             with open(path, "w", encoding="utf-8") as handle:
                 for name, documents in self._collections.items():
-                    handle.write(
-                        json.dumps(
-                            {"op": "insert_many", "c": name, "d": list(documents.values())}
+                    handle.write(json.dumps({"op": "create", "c": name}) + "\n")
+                    if documents:
+                        handle.write(
+                            json.dumps(
+                                {"op": "insert_many", "c": name, "d": list(documents.values())}
+                            )
+                            + "\n"
                         )
-                        + "\n"
-                    )
             self._wal = open(path, "a", encoding="utf-8")
 
     # --- primitive ops (no locking/logging) -----------------------------------
@@ -273,6 +389,14 @@ class InMemoryStore(DocumentStore):
     def list_collections(self) -> list[str]:
         with self._lock:
             return list(self._collections.keys())
+
+    def create_collection(self, collection: str) -> bool:
+        with self._lock:
+            if collection in self._collections:
+                return False
+            self._collections[collection] = {}
+            self._log({"op": "create", "c": collection})
+            return True
 
     def drop(self, collection: str) -> None:
         with self._lock:

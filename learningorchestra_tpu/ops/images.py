@@ -24,6 +24,7 @@ from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.core.table import ColumnTable
 from learningorchestra_tpu.ops.pca import pca_embedding
 from learningorchestra_tpu.ops.tsne import tsne_embedding
+from learningorchestra_tpu.utils.paths import safe_filename
 
 IMAGE_FORMAT = ".png"
 
@@ -65,6 +66,8 @@ def create_embedding_image(
 ) -> str:
     """Embed ``parent_filename`` with ``method`` ("pca"/"tsne") and write
     ``<images_path>/<output_filename>.png``. Returns the image path."""
+    if not safe_filename(output_filename):
+        raise ValueError(f"unsafe image filename {output_filename!r}")
     embed = EMBEDDINGS[method]
     table = ColumnTable.from_store(store, parent_filename).dropna()
     encoded, _ = table.encoded()

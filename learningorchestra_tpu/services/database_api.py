@@ -18,7 +18,13 @@ from learningorchestra_tpu.core.ingest import (
     write_ingest_metadata,
 )
 from learningorchestra_tpu.core.jobs import JobManager
-from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore, parse_query
+from learningorchestra_tpu.core.store import (
+    METADATA_ID,
+    ROW_ID,
+    DocumentStore,
+    UnsupportedQueryError,
+    parse_query,
+)
 from learningorchestra_tpu.utils.web import WebApp
 
 MESSAGE_RESULT = "result"
@@ -57,11 +63,19 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
 
     @app.route("/files/<filename>", methods=("GET",))
     def read_file(request, filename):
-        limit = int(request.args.get("limit", PAGINATE_FILE_LIMIT))
+        try:
+            limit = int(request.args.get("limit", PAGINATE_FILE_LIMIT))
+            skip = int(request.args.get("skip", 0))
+        except ValueError:
+            return {MESSAGE_RESULT: "invalid skip/limit"}, 400
         limit = min(limit, PAGINATE_FILE_LIMIT)
-        skip = int(request.args.get("skip", 0))
-        query = parse_query(request.args.get("query"))
-        documents = list(store.find(filename, query, skip=skip, limit=limit))
+        try:
+            query = parse_query(request.args.get("query"))
+            documents = list(store.find(filename, query, skip=skip, limit=limit))
+        except UnsupportedQueryError as error:
+            return {MESSAGE_RESULT: str(error)}, 400
+        except (ValueError, SyntaxError):  # unparseable query string
+            return {MESSAGE_RESULT: "invalid query"}, 400
         return {MESSAGE_RESULT: documents}, 200
 
     @app.route("/files", methods=("GET",))

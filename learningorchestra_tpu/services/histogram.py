@@ -35,7 +35,14 @@ def create_app(store: DocumentStore) -> WebApp:
             validators.fields_in_metadata(store, parent_filename, fields)
         except validators.ValidationError as error:
             return {MESSAGE_RESULT: error.args[0]}, 406
-        create_histogram(store, parent_filename, histogram_filename, list(fields))
+        # Atomic claim closes the duplicate-create race (SURVEY §5).
+        if not store.create_collection(histogram_filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_HISTOGRAM_DUPLICATE}, 409
+        try:
+            create_histogram(store, parent_filename, histogram_filename, list(fields))
+        except BaseException:
+            store.drop(histogram_filename)
+            raise
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     return app

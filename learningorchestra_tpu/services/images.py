@@ -8,6 +8,7 @@ missing on GET/DELETE → 404 with ``file_not_found``."""
 
 from __future__ import annotations
 
+import contextlib
 import os
 
 from learningorchestra_tpu.core.store import DocumentStore
@@ -19,6 +20,13 @@ MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
 MESSAGE_DELETED_FILE = "deleted_file"
 
+# In-flight create claims are `<name>.png.part` markers: atomic (O_EXCL)
+# duplicate gating without ever exposing a 0-byte PNG to GET/DELETE. A
+# crash can leave a stale marker blocking the name; DELETE on the name
+# clears it once the PNG exists, a stale-only marker needs operator
+# cleanup (the reference has no equivalent safeguard at all).
+CLAIM_SUFFIX = ".part"
+
 
 def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
     """``method`` is "tsne" or "pca"; the request filename key follows it."""
@@ -26,24 +34,65 @@ def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
     filename_key = f"{method}_filename"
     os.makedirs(images_path, exist_ok=True)
 
+    def image_path(name: str) -> str:
+        return os.path.join(images_path, name + IMAGE_FORMAT)
+
     def image_exists(name: str) -> bool:
+        """The finished PNG exists — what GET/DELETE see."""
         return (name + IMAGE_FORMAT) in os.listdir(images_path)
+
+    def name_taken(name: str) -> bool:
+        """Finished PNG *or* an in-flight claim — the duplicate gate."""
+        listing = os.listdir(images_path)
+        return (name + IMAGE_FORMAT) in listing or (
+            name + IMAGE_FORMAT + CLAIM_SUFFIX
+        ) in listing
+
+    def claim_image(name: str) -> bool:
+        """Atomically claim the name with a ``.part`` marker; False if a
+        concurrent create won. The marker — not the PNG — carries the
+        claim, so an in-progress image is never visible to GET/DELETE."""
+        try:
+            fd = os.open(
+                image_path(name) + CLAIM_SUFFIX,
+                os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+            )
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def release_claim(name: str, keep_png: bool) -> None:
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(image_path(name) + CLAIM_SUFFIX)
+        if not keep_png:
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(image_path(name))  # partially rendered output
 
     @app.route("/images/<parent_filename>", methods=("POST",))
     def create_image(request, parent_filename):
         body = request.get_json()
         output_filename = body[filename_key]
         label_name = body.get("label_name")
-        if image_exists(output_filename):
+        if not validators.safe_filename(output_filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_INVALID_FILENAME}, 406
+        if name_taken(output_filename):
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
         try:
             validators.filename_exists(store, parent_filename)
             validators.label_in_metadata(store, parent_filename, label_name)
         except validators.ValidationError as error:
             return {MESSAGE_RESULT: error.args[0]}, 406
-        create_embedding_image(
-            store, parent_filename, label_name, output_filename, images_path, method
-        )
+        if not claim_image(output_filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
+        try:
+            create_embedding_image(
+                store, parent_filename, label_name, output_filename, images_path, method
+            )
+        except BaseException:
+            release_claim(output_filename, keep_png=False)
+            raise
+        release_claim(output_filename, keep_png=True)
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     @app.route("/images", methods=("GET",))
@@ -52,17 +101,17 @@ def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
 
     @app.route("/images/<filename>", methods=("GET",))
     def get_image(request, filename):
-        if not image_exists(filename):
+        if not validators.safe_filename(filename) or not image_exists(filename):
             return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
-        return send_file(
-            os.path.join(images_path, filename + IMAGE_FORMAT), "image/png"
-        )
+        return send_file(image_path(filename), "image/png")
 
     @app.route("/images/<filename>", methods=("DELETE",))
     def delete_image(request, filename):
-        if not image_exists(filename):
+        if not validators.safe_filename(filename) or not image_exists(filename):
             return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
-        os.remove(os.path.join(images_path, filename + IMAGE_FORMAT))
+        os.remove(image_path(filename))
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(image_path(filename) + CLAIM_SUFFIX)
         return {MESSAGE_RESULT: MESSAGE_DELETED_FILE}, 200
 
     return app

@@ -33,7 +33,15 @@ def create_app(store: DocumentStore) -> WebApp:
             validators.fields_in_metadata(store, parent_filename, fields)
         except validators.ValidationError as error:
             return {MESSAGE_RESULT: error.args[0]}, 406
-        project(store, parent_filename, projection_filename, list(fields))
+        # Atomic claim: concurrent duplicate creates get exactly one 201,
+        # the loser a 409 (the check-then-act race SURVEY §5 flags).
+        if not store.create_collection(projection_filename):
+            return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
+        try:
+            project(store, parent_filename, projection_filename, list(fields))
+        except BaseException:
+            store.drop(projection_filename)
+            raise
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     return app

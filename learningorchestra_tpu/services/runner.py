@@ -9,7 +9,11 @@ learningorchestra_tpu.services.runner`` is the deployment entrypoint;
 Environment:
 - ``LO_DATA_DIR`` — store WAL directory (default ``./lo_data``)
 - ``LO_IMAGES_DIR`` — PNG volume root (default ``<data>/images``)
-- ``LO_HOST`` — bind host (default 0.0.0.0)
+- ``LO_HOST`` — bind host. Defaults to ``127.0.0.1``: the model-builder
+  service executes request-supplied preprocessor code (reference parity),
+  so exposing the stack beyond localhost must be an explicit opt-in
+  (``LO_HOST=0.0.0.0``) behind whatever sandboxing the deployment adds —
+  see deploy/README.md.
 """
 
 from __future__ import annotations
@@ -59,15 +63,22 @@ def start_all(
     store: Optional[DocumentStore] = None,
     images_dir: Optional[str] = None,
     host: str = "127.0.0.1",
+    ephemeral: bool = False,
 ) -> tuple[DocumentStore, list[ServerThread]]:
     """Start all seven services on their reference ports; returns the
-    shared store and the server threads (callers stop() them)."""
+    shared store and the server threads (callers stop() them).
+
+    ``ephemeral=True`` binds OS-assigned ports instead (tests can't
+    assume 5000-5006 are free); each server's ``canonical_port`` records
+    which reference port it stands in for, its ``port`` the actual bind.
+    """
     store = store if store is not None else InMemoryStore()
     images_dir = images_dir or os.path.join(os.getcwd(), "lo_images")
-    servers = [
-        ServerThread(app, host, port).start()
-        for port, app in build_apps(store, images_dir).items()
-    ]
+    servers = []
+    for port, app in build_apps(store, images_dir).items():
+        server = ServerThread(app, host, 0 if ephemeral else port)
+        server.canonical_port = port
+        servers.append(server.start())
     return store, servers
 
 
@@ -76,7 +87,7 @@ def main() -> None:
     images_dir = os.environ.get(
         "LO_IMAGES_DIR", os.path.join(data_dir, "images")
     )
-    host = os.environ.get("LO_HOST", "0.0.0.0")
+    host = os.environ.get("LO_HOST", "127.0.0.1")
     store = InMemoryStore(data_dir=data_dir)
     _, servers = start_all(store, images_dir, host)
     print(

@@ -8,6 +8,7 @@ projection_image/projection.py:27-30, histogram_image/histogram.py:22-25
 from __future__ import annotations
 
 from learningorchestra_tpu.core.store import DocumentStore
+from learningorchestra_tpu.utils.paths import safe_filename  # noqa: F401 — REST-layer re-export
 
 MESSAGE_INVALID_FIELDS = "invalid_fields"
 MESSAGE_INVALID_FILENAME = "invalid_filename"

@@ -147,3 +147,106 @@ def test_job_manager_rejects_active_duplicate_name():
     jm.wait("j", timeout=5)
     jm.submit("j", _time.sleep, 0.01)  # allowed after completion
     assert jm.wait("j", timeout=5).state == "finished"
+
+
+def test_matches_query_operators():
+    doc = {"a": 5, "s": "x"}
+    assert matches(doc, {"a": {"$gt": 4}})
+    assert not matches(doc, {"a": {"$gt": 5}})
+    assert matches(doc, {"a": {"$gte": 5, "$lte": 5}})
+    assert matches(doc, {"a": {"$lt": 6}})
+    assert not matches(doc, {"a": {"$lt": 5}})
+    assert matches(doc, {"a": {"$ne": 4}})
+    assert not matches(doc, {"a": {"$ne": 5}})
+    assert matches(doc, {"a": {"$eq": 5}})
+    assert matches(doc, {"s": {"$in": ["x", "y"]}})
+    assert not matches(doc, {"s": {"$nin": ["x", "y"]}})
+    assert matches(doc, {"missing": {"$exists": False}})
+    assert matches(doc, {"a": {"$exists": True}})
+    assert not matches(doc, {"a": {"$exists": False}})
+    # operator on a missing key never matches
+    assert not matches(doc, {"missing": {"$gt": 0}})
+    # incomparable types (None vs number) are a non-match, not an error
+    assert not matches({"a": None}, {"a": {"$gt": 0}})
+    # a non-operator dict value still means plain equality
+    assert matches({"a": {"x": 1}}, {"a": {"x": 1}})
+
+
+def test_find_with_operator_query(store):
+    store.insert_many("ds", [{ROW_ID: i, "x": i} for i in range(1, 8)])
+    assert [d[ROW_ID] for d in store.find("ds", {"x": {"$gte": 3, "$lt": 6}})] == [
+        3,
+        4,
+        5,
+    ]
+    assert [d[ROW_ID] for d in store.find("ds", {"x": {"$in": [2, 7]}})] == [2, 7]
+
+
+def test_create_collection_atomic_claim(store):
+    assert store.create_collection("ds") is True
+    assert store.create_collection("ds") is False
+    assert "ds" in store.list_collections()
+    # claimed collection accepts documents as usual
+    store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": False})
+    assert store.metadata("ds")["finished"] is False
+
+
+def test_create_collection_concurrent_single_winner(store):
+    wins = []
+    barrier = threading.Barrier(8)
+
+    def claim():
+        barrier.wait()
+        if store.create_collection("target"):
+            wins.append(1)
+
+    threads = [threading.Thread(target=claim) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 1
+
+
+def test_wal_replays_created_empty_collection(tmp_path):
+    data_dir = str(tmp_path / "wal")
+    first = InMemoryStore(data_dir=data_dir)
+    first.create_collection("claimed")
+    first.insert_one("full", {ROW_ID: 1})
+    first.compact()
+    second = InMemoryStore(data_dir=data_dir)
+    assert sorted(second.list_collections()) == ["claimed", "full"]
+
+
+def test_matches_mongo_missing_field_and_logicals():
+    from learningorchestra_tpu.core.store import UnsupportedQueryError
+
+    # $ne / $nin match documents lacking the field (Mongo semantics)
+    assert matches({"a": 1}, {"b": {"$ne": 5}})
+    assert matches({"a": 1}, {"b": {"$nin": [5]}})
+    assert not matches({"b": 5}, {"b": {"$ne": 5}})
+    # $regex
+    assert matches({"s": "hello"}, {"s": {"$regex": "ell"}})
+    assert not matches({"s": "hello"}, {"s": {"$regex": "^x"}})
+    assert not matches({"s": 5}, {"s": {"$regex": "5"}})
+    # $not
+    assert matches({"a": 1}, {"a": {"$not": {"$gt": 5}}})
+    assert not matches({"a": 9}, {"a": {"$not": {"$gt": 5}}})
+    # top-level logicals
+    assert matches({"a": 1}, {"$or": [{"a": 1}, {"a": 2}]})
+    assert not matches({"a": 3}, {"$or": [{"a": 1}, {"a": 2}]})
+    assert matches({"a": 1, "b": 2}, {"$and": [{"a": 1}, {"b": 2}]})
+    assert matches({"a": 3}, {"$nor": [{"a": 1}, {"a": 2}]})
+    # unknown operators raise (REST maps to 400) instead of silent no-match
+    with pytest.raises(UnsupportedQueryError):
+        matches({"a": 1}, {"a": {"$mod": [2, 0]}})
+    with pytest.raises(UnsupportedQueryError):
+        matches({"a": 1}, {"$where": "1"})
+
+
+def test_ingest_claim_shares_create_collection_gate(store, titanic_csv):
+    from learningorchestra_tpu.core.ingest import write_ingest_metadata
+
+    assert store.create_collection("claimed")
+    with pytest.raises(KeyError):
+        write_ingest_metadata(store, "claimed", titanic_csv)

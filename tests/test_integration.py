@@ -23,17 +23,34 @@ from learningorchestra_tpu.services.runner import start_all
 from tests.test_frame import DOCUMENTED_PREPROCESSOR
 
 
+PORT_ATTRS = {
+    5000: (DatabaseApi, "DATABASE_API_PORT"),
+    5001: (Projection, "PROJECTION_PORT"),
+    5002: (Model, "MODEL_BUILDER_PORT"),
+    5003: (DataTypeHandler, "DATA_TYPE_HANDLER_PORT"),
+    5004: (Histogram, "HISTOGRAM_PORT"),
+    5005: (lo_client.Tsne, "TSNE_PORT"),
+    5006: (Pca, "PCA_PORT"),
+}
+
+
 @pytest.fixture(scope="module")
 def stack(tmp_path_factory):
     store = InMemoryStore()
     images_dir = str(tmp_path_factory.mktemp("images"))
-    try:
-        store, servers = start_all(store, images_dir)
-    except OSError as error:
-        pytest.skip(f"service ports busy: {error}")
+    # Ephemeral ports: the suite must not depend on 5000-5006 being free
+    # (a previously running stack would otherwise error the whole module).
+    store, servers = start_all(store, images_dir, ephemeral=True)
+    saved = {}
+    for server in servers:
+        cls, attr = PORT_ATTRS[server.canonical_port]
+        saved[(cls, attr)] = getattr(cls, attr)
+        setattr(cls, attr, str(server.port))
     lo_client.AsyncronousWait.WAIT_TIME = 0.05  # fast polls in tests
     Context("127.0.0.1")
     yield store
+    for (cls, attr), value in saved.items():
+        setattr(cls, attr, value)
     for server in servers:
         server.stop()
 

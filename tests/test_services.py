@@ -254,3 +254,188 @@ class TestImagesService:
         )
         assert response.status_code == 406
         assert body(response) == {"result": "invalid_field"}
+
+
+class TestQueryPassThrough:
+    def test_operator_query_over_rest(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        query = json.dumps({"_id": {"$gt": 0, "$lte": 3}})
+        response = client.get(f"/files/titanic?limit=20&query={query}")
+        assert response.status_code == 200
+        rows = body(response)["result"]
+        assert [r["_id"] for r in rows] == [1, 2, 3]
+
+    def test_in_operator_on_string_field(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        query = json.dumps({"Sex": {"$in": ["female"]}})
+        response = client.get(f"/files/titanic?limit=20&query={query}")
+        rows = body(response)["result"]
+        assert rows and all(r["Sex"] == "female" for r in rows)
+
+
+class TestConcurrentCreate:
+    def test_duplicate_projection_one_winner(self, ingested):
+        """The check-then-act race SURVEY §5 flags: concurrent duplicate
+        creates must produce exactly one 201 and one 409 — never a 500."""
+        import threading
+
+        app = projection.create_app(ingested)
+        results = []
+        barrier = threading.Barrier(2)
+
+        def create():
+            client = app.test_client()
+            barrier.wait()
+            response = client.post(
+                "/projections/titanic",
+                json={"projection_filename": "race_proj", "fields": ["Name"]},
+            )
+            results.append(response.status_code)
+
+        threads = [threading.Thread(target=create) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == [201, 409]
+
+    def test_duplicate_histogram_one_winner(self, ingested):
+        import threading
+
+        app = histogram.create_app(ingested)
+        results = []
+        barrier = threading.Barrier(2)
+
+        def create():
+            client = app.test_client()
+            barrier.wait()
+            response = client.post(
+                "/histograms/titanic",
+                json={"histogram_filename": "race_hist", "fields": ["Sex"]},
+            )
+            results.append(response.status_code)
+
+        threads = [threading.Thread(target=create) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == [201, 409]
+
+
+class TestImageFilenameSafety:
+    def test_traversal_rejected_on_create(self, store, tmp_path):
+        client = images.create_app(store, str(tmp_path), "pca").test_client()
+        for bad in ("../evil", "a/b", "..", ""):
+            response = client.post(
+                "/images/whatever", json={"pca_filename": bad, "label_name": None}
+            )
+            assert response.status_code == 406, bad
+            assert body(response) == {"result": "invalid_filename"}
+        assert list(tmp_path.parent.glob("*.png")) == []
+
+    def test_traversal_rejected_on_get_delete(self, store, tmp_path):
+        outside = tmp_path / "secret.png"
+        outside.write_bytes(b"\x89PNG....")
+        images_dir = tmp_path / "imgs"
+        client = images.create_app(store, str(images_dir), "pca").test_client()
+        response = client.get("/images/..%2Fsecret")
+        assert response.status_code == 404
+        response = client.delete("/images/..%2Fsecret")
+        assert response.status_code == 404
+        assert outside.exists()
+
+
+class TestQueryErrors:
+    def test_unsupported_operator_400(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        query = json.dumps({"Name": {"$text": "x"}})
+        response = client.get(f"/files/titanic?limit=5&query={query}")
+        assert response.status_code == 400
+        assert "unsupported query operator" in body(response)["result"]
+
+    def test_or_query_over_rest(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        query = json.dumps({"$or": [{"_id": 1}, {"_id": 4}]})
+        response = client.get(f"/files/titanic?limit=20&query={query}")
+        rows = body(response)["result"]
+        assert [r["_id"] for r in rows] == [1, 4]
+
+
+class TestInFlightImageClaim:
+    def test_placeholder_invisible_to_get_and_delete(self, store, tmp_path, monkeypatch):
+        """While a create is computing, GET/DELETE must 404 (no 0-byte
+        PNG leak) and a concurrent duplicate POST must 409."""
+        import threading
+
+        from learningorchestra_tpu.core.table import ColumnTable, write_table
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        table = ColumnTable.from_lists(
+            {"a": rng.normal(size=20).tolist(), "b": rng.normal(size=20).tolist()}
+        )
+        write_table(
+            store, "n", table, {"filename": "n", "finished": True, "fields": ["a", "b"]}
+        )
+        app = images.create_app(store, str(tmp_path), "pca")
+        client = app.test_client()
+
+        entered = threading.Event()
+        release = threading.Event()
+        import learningorchestra_tpu.services.images as images_module
+
+        real_create = images_module.create_embedding_image
+
+        def slow_create(*args, **kwargs):
+            entered.set()
+            release.wait(timeout=10)
+            return real_create(*args, **kwargs)
+
+        monkeypatch.setattr(images_module, "create_embedding_image", slow_create)
+
+        result = {}
+
+        def do_create():
+            result["create"] = app.test_client().post(
+                "/images/n", json={"pca_filename": "slow", "label_name": None}
+            )
+
+        t = threading.Thread(target=do_create)
+        t.start()
+        assert entered.wait(timeout=10)
+        assert client.get("/images/slow").status_code == 404
+        assert client.delete("/images/slow").status_code == 404
+        dup = client.post("/images/n", json={"pca_filename": "slow", "label_name": None})
+        assert dup.status_code == 409
+        release.set()
+        t.join(timeout=30)
+        assert result["create"].status_code == 201
+        assert client.get("/images/slow").status_code == 200
+        # claim marker cleaned up
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["slow.png"]
+
+
+class TestMalformedQueries400:
+    def test_unparseable_and_nondict_queries(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        for bad in ("hello", "5", "[1,2]"):
+            response = client.get(f"/files/titanic?limit=5&query={bad}")
+            assert response.status_code == 400, bad
+        response = client.get("/files/titanic?limit=abc")
+        assert response.status_code == 400
+
+    def test_malformed_operands(self, ingested):
+        client = database_api.create_app(ingested).test_client()
+        bads = [
+            {"a": {"$nin": 5}},
+            {"s": {"$regex": "("}},
+            {"a": {"$not": 5}},
+            {"$or": {"a": 1}},
+            {"a": {"$in": 3}},
+        ]
+        for bad in bads:
+            response = client.get(
+                f"/files/titanic?limit=5&query={json.dumps(bad)}"
+            )
+            assert response.status_code == 400, bad
