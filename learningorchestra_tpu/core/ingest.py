@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import csv
 import io
+import tempfile
 from contextlib import ExitStack, closing
 from datetime import datetime, timezone
 from typing import Iterator, TextIO
@@ -100,48 +101,87 @@ def write_ingest_metadata(store: DocumentStore, filename: str, url: str) -> None
     )
 
 
+def _local_csv_path(url: str, stack: ExitStack) -> str:
+    """A local filesystem path for ``url``, downloading http(s) bodies to
+    a temp file (deleted when ``stack`` closes). The columnar parsers —
+    native C++ and Python alike — work from a file."""
+    if not url.startswith(("http://", "https://")):
+        return url[len("file://") :] if url.startswith("file://") else url
+    response = stack.enter_context(closing(requests.get(url, stream=True)))
+    response.raise_for_status()
+    handle = stack.enter_context(
+        tempfile.NamedTemporaryFile(suffix=".csv", delete=True)
+    )
+    for chunk in response.iter_content(chunk_size=1 << 20):
+        handle.write(chunk)
+    handle.flush()
+    return handle.name
+
+
+def _python_raw_columns(path: str) -> tuple[list[str], list[list[str]]]:
+    """Python fallback for :func:`native.loader.read_csv_raw_columns`:
+    same raw-string contract, tolerant of ragged rows (short rows pad
+    with ``""``, oversized rows truncate to the header width)."""
+    with open(path, encoding="utf-8", newline="") as handle:
+        reader = _csv_rows(handle)
+        header = next(reader)
+        width = len(header)
+        columns: list[list[str]] = [[] for _ in range(width)]
+        for row in reader:
+            if not row:
+                continue
+            for i in range(width):
+                columns[i].append(row[i] if i < len(row) else "")
+    return header, columns
+
+
 def ingest_csv(
     store: DocumentStore,
     filename: str,
     url: str,
     batch_size: int = BATCH_SIZE,
 ) -> int:
-    """Stream the CSV at ``url`` into collection ``filename``.
+    """Ingest the CSV at ``url`` into collection ``filename``,
+    column-major.
 
-    Rows become documents ``{header[i]: value, _id: 1..N}`` with values
-    kept as strings (type conversion is the fieldtypes service's job).
-    Flips the metadata to ``finished: true`` with the field list when the
-    stream drains. Returns the row count.
+    Observable contract unchanged from the reference (rows are documents
+    ``{header[i]: value, _id: 1..N}``, values kept as raw strings, type
+    conversion is the fieldtypes service's job, metadata flips to
+    ``finished: true`` with the field list at the end — reference:
+    microservices/database_api_image/database.py:144-216) — but the body
+    lands as the store's columnar block via batched ``insert_columns``:
+    the native C++ parser (native/csv_loader.cpp) feeds column lists
+    straight in, and no per-row Python dict is ever built. Returns the
+    row count.
+
+    Memory model: the dataset body is resident in the store regardless
+    (that is what an in-memory store is); ingest transiently holds a
+    second copy (the parse result) before the batched hand-off, so peak
+    is ~2× the body — same order as the reference's Mongo working set.
     """
-    # Always the streaming path: memory is bounded at one batch
-    # regardless of file size, and it is tolerant of ragged rows. The
-    # native C++ parser serves the columnar ``ColumnTable.from_csv``
-    # route, where full materialization is inherent.
-    with ExitStack() as stack:
-        reader = _csv_rows(_open_text(url, stack))
-        file_header = next(reader)
+    from learningorchestra_tpu.native.loader import read_csv_raw_columns
 
-        batch: list[dict] = []
-        row_id = 0
-        width = len(file_header)
-        for row in reader:
-            if not row:
-                continue
-            row_id += 1
-            document = {
-                file_header[i]: (row[i] if i < len(row) else "") for i in range(width)
-            }
-            document[ROW_ID] = row_id
-            batch.append(document)
-            if len(batch) >= batch_size:
-                store.insert_many(filename, batch)
-                batch = []
-        if batch:
-            store.insert_many(filename, batch)
+    with ExitStack() as stack:
+        path = _local_csv_path(url, stack)
+        parsed = read_csv_raw_columns(path)
+        if parsed is None:
+            parsed = _python_raw_columns(path)
+    file_header, raw_columns = parsed
+
+    from learningorchestra_tpu.core.table import insert_columns_batched
+
+    # Duplicate header names collapse last-wins, as the reference's
+    # per-row dict build did (database.py:156-169); a CSV column named
+    # `_id` is discarded the same way the reference's row ids overwrote
+    # it (database.py:161-168) — row ids are always 1..N.
+    columns: dict[str, list] = dict(zip(file_header, raw_columns))
+    columns.pop(ROW_ID, None)
+    num_rows = len(raw_columns[0]) if raw_columns else 0
+    insert_columns_batched(store, filename, columns, batch_size=batch_size)
 
     store.update_one(
         filename,
         {ROW_ID: METADATA_ID},
         {FINISHED: True, "fields": file_header},
     )
-    return row_id
+    return num_rows

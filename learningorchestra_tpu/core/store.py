@@ -202,6 +202,30 @@ class DocumentStore:
         for document in documents:
             self.insert_one(collection, document)
 
+    def insert_columns(
+        self,
+        collection: str,
+        columns: dict[str, list],
+        start_id: Optional[int] = None,
+    ) -> None:
+        """Bulk column-major append: rows ``start_id..start_id+n-1`` with
+        ``{field: values[i]}``. The storage→compute data plane's write
+        half — backends keep this columnar end to end so dataset bodies
+        never pay per-row Python dict costs. Default implementation
+        degrades to ``insert_many`` for row-oriented backends.
+        """
+        lengths = {len(values) for values in columns.values()}
+        if len(lengths) > 1:
+            raise ValueError("ragged columns")
+        num_rows = lengths.pop() if lengths else 0
+        documents = []
+        for i in range(num_rows):
+            document = {name: values[i] for name, values in columns.items()}
+            if start_id is not None:
+                document[ROW_ID] = start_id + i
+            documents.append(document)
+        self.insert_many(collection, documents)
+
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         """Set ``new_values`` on the first document matching ``query``
         (Mongo ``update_one(filter, {"$set": ...})`` semantics)."""
@@ -219,6 +243,24 @@ class DocumentStore:
         """
         for doc_id, value in values_by_id.items():
             self.update_one(collection, {ROW_ID: doc_id}, {field: value})
+
+    def set_column(
+        self,
+        collection: str,
+        field: str,
+        values: list,
+        start_id: int = 1,
+    ) -> None:
+        """Replace ``field`` for the contiguous rows ``start_id..`` with
+        ``values`` — the column-major write the fieldtypes conversion
+        uses (one bulk call per field; the reference issues 2 RPCs per
+        row per field, reference data_type_handler.py:47-77). Default
+        implementation degrades to ``set_field_values``."""
+        self.set_field_values(
+            collection,
+            field,
+            {start_id + i: value for i, value in enumerate(values)},
+        )
 
     # --- reads ----------------------------------------------------------------
     def find(
@@ -279,7 +321,7 @@ class DocumentStore:
         return bool(meta and meta.get("finished"))
 
 
-def _group_count(documents: list[dict], field: str) -> list[dict]:
+def _group_count(documents: Iterator[dict], field: str) -> list[dict]:
     counts: dict[Any, int] = {}
     for document in documents:
         if document.get(ROW_ID) == METADATA_ID:
@@ -287,6 +329,124 @@ def _group_count(documents: list[dict], field: str) -> list[dict]:
         key = document.get(field)
         counts[key] = counts.get(key, 0) + 1
     return [{"_id": key, "count": count} for key, count in counts.items()]
+
+
+def _is_int_id(doc_id: Any) -> bool:
+    return isinstance(doc_id, int) and not isinstance(doc_id, bool)
+
+
+class _Collection:
+    """One collection's storage: a contiguous column-major block for the
+    dataset body plus a row-document overlay for everything else.
+
+    The block holds rows ``block_start..block_start+n-1`` as parallel
+    Python lists, one per field — the shape bulk ingest/projection write
+    and ``read_columns`` returns, so dataset bodies never materialise as
+    per-row dicts (the cost SURVEY §7.1's columnar-cache requirement
+    exists to avoid). The overlay holds the ``_id: 0`` metadata document
+    and any out-of-band inserts. Ids never overlap between the two.
+    """
+
+    __slots__ = ("block_fields", "block_columns", "block_start", "rows")
+
+    def __init__(self):
+        self.block_fields: list[str] = []
+        self.block_columns: dict[str, list] = {}
+        self.block_start = 1
+        self.rows: dict[Any, dict] = {}
+
+    # --- block geometry -------------------------------------------------------
+    @property
+    def block_rows(self) -> int:
+        if not self.block_columns:
+            return 0
+        return len(next(iter(self.block_columns.values())))
+
+    @property
+    def block_stop(self) -> int:
+        """One past the last block id."""
+        return self.block_start + self.block_rows
+
+    def in_block(self, doc_id: Any) -> bool:
+        return _is_int_id(doc_id) and self.block_start <= doc_id < self.block_stop
+
+    def has_id(self, doc_id: Any) -> bool:
+        return self.in_block(doc_id) or doc_id in self.rows
+
+    def next_id(self) -> int:
+        top = self.block_stop - 1 if self.block_columns else 0
+        for doc_id in self.rows:
+            if _is_int_id(doc_id) and doc_id > top:
+                top = doc_id
+        return top + 1
+
+    # --- row synthesis --------------------------------------------------------
+    def block_document(self, doc_id: int) -> dict:
+        i = doc_id - self.block_start
+        document = {name: self.block_columns[name][i] for name in self.block_fields}
+        document[ROW_ID] = doc_id
+        return document
+
+    def document(self, doc_id: Any) -> dict:
+        if self.in_block(doc_id):
+            return self.block_document(doc_id)
+        return dict(self.rows[doc_id])
+
+    def iter_ids(self) -> Iterator:
+        """All ids: ints ascending (overlay and block merged), then
+        non-int ids in string order."""
+        import heapq
+
+        overlay_ints = sorted(i for i in self.rows if _is_int_id(i))
+        yield from heapq.merge(
+            overlay_ints, range(self.block_start, self.block_stop)
+        )
+        yield from sorted(
+            (i for i in self.rows if not _is_int_id(i)), key=str
+        )
+
+    def overlay_data_ids(self) -> list:
+        """Overlay ids other than the metadata document."""
+        return [i for i in self.rows if i != METADATA_ID]
+
+    # --- block mutation -------------------------------------------------------
+    def ensure_block_field(self, field: str) -> list:
+        if field == ROW_ID:
+            raise KeyError("_id is not a block field")
+        column = self.block_columns.get(field)
+        if column is None:
+            column = [None] * self.block_rows
+            self.block_columns[field] = column
+            self.block_fields.append(field)
+        return column
+
+    def set_block_values(self, doc_id: int, new_values: dict) -> None:
+        i = doc_id - self.block_start
+        for field, value in new_values.items():
+            if field == ROW_ID:
+                continue
+            self.ensure_block_field(field)[i] = value
+
+    def append_columns(
+        self, fields: list[str], columns: dict[str, list], start_id: int
+    ) -> None:
+        num_new = len(columns[fields[0]]) if fields else 0
+        if self.block_columns:
+            if start_id != self.block_stop:
+                raise ValueError(
+                    f"columnar append must start at id {self.block_stop}, "
+                    f"got {start_id}"
+                )
+        else:
+            self.block_start = start_id
+        for doc_id in range(start_id, start_id + num_new):
+            if doc_id in self.rows:
+                raise KeyError(f"duplicate _id {doc_id!r}")
+        for field in fields:
+            self.ensure_block_field(field)
+        pad = [None] * num_new
+        for field, column in self.block_columns.items():
+            column.extend(columns[field] if field in columns else pad)
 
 
 class InMemoryStore(DocumentStore):
@@ -299,7 +459,7 @@ class InMemoryStore(DocumentStore):
 
     def __init__(self, data_dir: Optional[str] = None):
         self._lock = threading.RLock()
-        self._collections: dict[str, dict[Any, dict]] = {}
+        self._collections: dict[str, _Collection] = {}
         self._wal = None
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
@@ -327,6 +487,10 @@ class InMemoryStore(DocumentStore):
                 elif op == "insert_many":
                     for document in record["d"]:
                         self._apply_insert(record["c"], document)
+                elif op == "insert_cols":
+                    self._apply_insert_columns(
+                        record["c"], record["d"], record["s"]
+                    )
                 elif op == "update":
                     self._apply_update(record["c"], record["q"], record["v"])
                 elif op == "set_field":
@@ -335,8 +499,12 @@ class InMemoryStore(DocumentStore):
                     self._apply_set_field(
                         record["c"], record["f"], dict(record["d"])
                     )
+                elif op == "set_col":
+                    self._apply_set_column(
+                        record["c"], record["f"], record["d"], record["s"]
+                    )
                 elif op == "create":
-                    self._collections.setdefault(record["c"], {})
+                    self._collections.setdefault(record["c"], _Collection())
                 elif op == "drop":
                     self._collections.pop(record["c"], None)
 
@@ -347,12 +515,24 @@ class InMemoryStore(DocumentStore):
             path = self._wal.name
             self._wal.close()
             with open(path, "w", encoding="utf-8") as handle:
-                for name, documents in self._collections.items():
+                for name, col in self._collections.items():
                     handle.write(json.dumps({"op": "create", "c": name}) + "\n")
-                    if documents:
+                    if col.block_columns:
                         handle.write(
                             json.dumps(
-                                {"op": "insert_many", "c": name, "d": list(documents.values())}
+                                {
+                                    "op": "insert_cols",
+                                    "c": name,
+                                    "s": col.block_start,
+                                    "d": col.block_columns,
+                                }
+                            )
+                            + "\n"
+                        )
+                    if col.rows:
+                        handle.write(
+                            json.dumps(
+                                {"op": "insert_many", "c": name, "d": list(col.rows.values())}
                             )
                             + "\n"
                         )
@@ -360,30 +540,78 @@ class InMemoryStore(DocumentStore):
 
     # --- primitive ops (no locking/logging) -----------------------------------
     def _apply_insert(self, collection: str, document: dict) -> None:
-        bucket = self._collections.setdefault(collection, {})
+        col = self._collections.setdefault(collection, _Collection())
         doc_id = document.get(ROW_ID)
         if doc_id is None:
-            doc_id = (max((k for k in bucket if isinstance(k, int)), default=0) + 1)
+            doc_id = col.next_id()
             document = dict(document)
             document[ROW_ID] = doc_id
-        if doc_id in bucket:
+        if col.has_id(doc_id):
             raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
-        bucket[doc_id] = dict(document)
+        col.rows[doc_id] = dict(document)
+
+    def _apply_insert_columns(
+        self, collection: str, columns: dict[str, list], start_id: int
+    ) -> None:
+        col = self._collections.setdefault(collection, _Collection())
+        col.append_columns(list(columns.keys()), columns, start_id)
 
     def _apply_update(self, collection: str, query: dict, new_values: dict) -> None:
-        bucket = self._collections.get(collection, {})
-        for document in bucket.values():
-            if matches(document, query):
-                document.update(new_values)
+        col = self._collections.get(collection)
+        if col is None:
+            return
+        if list(query.keys()) == [ROW_ID] and (
+            _is_int_id(query[ROW_ID]) or isinstance(query[ROW_ID], str)
+        ):  # the dominant fast path: literal-id lookup
+            doc_id = query[ROW_ID]
+            if col.in_block(doc_id):
+                col.set_block_values(doc_id, new_values)
+            elif doc_id in col.rows:
+                col.rows[doc_id].update(new_values)
+            return
+        for doc_id in col.iter_ids():
+            if matches(col.document(doc_id), query):
+                if col.in_block(doc_id):
+                    col.set_block_values(doc_id, new_values)
+                else:
+                    col.rows[doc_id].update(new_values)
                 return
 
     def _apply_set_field(
         self, collection: str, field: str, values_by_id: dict
     ) -> None:
-        bucket = self._collections.get(collection, {})
+        col = self._collections.get(collection)
+        if col is None:
+            return
+        block_column = None
         for doc_id, value in values_by_id.items():
-            if doc_id in bucket:
-                bucket[doc_id][field] = value
+            if col.in_block(doc_id):
+                if block_column is None:
+                    block_column = col.ensure_block_field(field)
+                block_column[doc_id - col.block_start] = value
+            elif doc_id in col.rows:
+                col.rows[doc_id][field] = value
+
+    def _apply_set_column(
+        self, collection: str, field: str, values: list, start_id: int
+    ) -> None:
+        col = self._collections.get(collection)
+        if col is None:
+            return
+        # Whole-block replace: one list assignment, no per-id work.
+        if (
+            col.block_columns
+            and start_id == col.block_start
+            and len(values) == col.block_rows
+        ):
+            col.ensure_block_field(field)
+            col.block_columns[field] = list(values)
+            return
+        self._apply_set_field(
+            collection,
+            field,
+            {start_id + i: value for i, value in enumerate(values)},
+        )
 
     # --- DocumentStore implementation -----------------------------------------
     def list_collections(self) -> list[str]:
@@ -394,7 +622,7 @@ class InMemoryStore(DocumentStore):
         with self._lock:
             if collection in self._collections:
                 return False
-            self._collections[collection] = {}
+            self._collections[collection] = _Collection()
             self._log({"op": "create", "c": collection})
             return True
 
@@ -413,18 +641,39 @@ class InMemoryStore(DocumentStore):
             # Validate the whole batch before applying anything so a
             # duplicate-_id failure can't leave the in-memory state and
             # the WAL divergent (all-or-nothing).
-            bucket = self._collections.get(collection, {})
+            col = self._collections.get(collection) or _Collection()
             seen: set = set()
             for document in documents:
                 doc_id = document.get(ROW_ID)
                 if doc_id is None:
                     continue  # auto-assigned at apply time, cannot collide
-                if doc_id in bucket or doc_id in seen:
+                if col.has_id(doc_id) or doc_id in seen:
                     raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
                 seen.add(doc_id)
             for document in documents:
                 self._apply_insert(collection, document)
             self._log({"op": "insert_many", "c": collection, "d": documents})
+
+    def insert_columns(
+        self,
+        collection: str,
+        columns: dict[str, list],
+        start_id: Optional[int] = None,
+    ) -> None:
+        lengths = {len(values) for values in columns.values()}
+        if len(lengths) > 1:
+            raise ValueError("ragged columns")
+        if ROW_ID in columns:
+            raise ValueError("_id is implicit in insert_columns (start_id..)")
+        with self._lock:
+            col = self._collections.get(collection) or _Collection()
+            if start_id is None:
+                start_id = col.block_stop if col.block_columns else 1
+            # append_columns validates contiguity + overlay collisions
+            self._apply_insert_columns(collection, columns, start_id)
+            self._log(
+                {"op": "insert_cols", "c": collection, "s": start_id, "d": columns}
+            )
 
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         with self._lock:
@@ -445,6 +694,25 @@ class InMemoryStore(DocumentStore):
                 }
             )
 
+    def set_column(
+        self,
+        collection: str,
+        field: str,
+        values: list,
+        start_id: int = 1,
+    ) -> None:
+        with self._lock:
+            self._apply_set_column(collection, field, values, start_id)
+            self._log(
+                {
+                    "op": "set_col",
+                    "c": collection,
+                    "f": field,
+                    "s": start_id,
+                    "d": values,
+                }
+            )
+
     def find(
         self,
         collection: str,
@@ -452,34 +720,62 @@ class InMemoryStore(DocumentStore):
         skip: int = 0,
         limit: Optional[int] = None,
     ) -> Iterator[dict]:
-        with self._lock:
-            bucket = self._collections.get(collection, {})
-            ordered = sorted(
-                bucket.values(),
-                key=lambda doc: (not isinstance(doc.get(ROW_ID), int), doc.get(ROW_ID)),
-            )
         query = query or {}
-        produced = 0
-        skipped = 0
-        for document in ordered:
-            if not matches(document, query):
-                continue
-            if skipped < skip:
-                skipped += 1
-                continue
-            if limit is not None and produced >= limit:
-                break
-            produced += 1
-            yield dict(document)
+        results: list[dict] = []
+        with self._lock:
+            col = self._collections.get(collection)
+            if col is None:
+                return iter(())
+            produced = 0
+            skipped = 0
+            for doc_id in col.iter_ids():
+                document = col.document(doc_id)
+                if not matches(document, query):
+                    continue
+                if skipped < skip:
+                    skipped += 1
+                    continue
+                if limit is not None and produced >= limit:
+                    break
+                produced += 1
+                results.append(document)
+        return iter(results)
 
     def count(self, collection: str) -> int:
         with self._lock:
-            return len(self._collections.get(collection, {}))
+            col = self._collections.get(collection)
+            if col is None:
+                return 0
+            return col.block_rows + len(col.rows)
 
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
+        # Columnar fast path: the histogram's value-count $group runs
+        # straight over the block column — no row synthesis (the on-store
+        # analogue of the reference's Mongo-server $group pushdown).
         with self._lock:
-            documents = list(self._collections.get(collection, {}).values())
-        results: list[dict] = [dict(document) for document in documents]
+            col = self._collections.get(collection)
+            if (
+                col is not None
+                and len(pipeline) == 1
+                and "$group" in pipeline[0]
+                and not col.overlay_data_ids()
+            ):
+                key_expr = pipeline[0]["$group"].get("_id")
+                if isinstance(key_expr, str) and key_expr.startswith("$"):
+                    from collections import Counter
+
+                    field = key_expr[1:]
+                    if field == ROW_ID:
+                        values = list(range(col.block_start, col.block_stop))
+                    else:
+                        values = col.block_columns.get(field)
+                        if values is None:
+                            values = [None] * col.block_rows
+                    return [
+                        {"_id": key, "count": count}
+                        for key, count in Counter(values).items()
+                    ]
+        results: list[dict] = list(self.find(collection))
         for stage in pipeline:
             if "$match" in stage:
                 results = [doc for doc in results if matches(doc, stage["$match"])]
@@ -488,10 +784,32 @@ class InMemoryStore(DocumentStore):
                 key_expr = group.get("_id")
                 if not (isinstance(key_expr, str) and key_expr.startswith("$")):
                     raise NotImplementedError(f"unsupported $group key {key_expr!r}")
-                results = _group_count(results, key_expr[1:])
+                results = _group_count(iter(results), key_expr[1:])
             else:
                 raise NotImplementedError(f"unsupported pipeline stage {stage}")
         return results
+
+    def read_columns(
+        self, collection: str, fields: Optional[list[str]] = None
+    ) -> dict[str, list]:
+        with self._lock:
+            col = self._collections.get(collection)
+            if col is None:
+                return {field: [] for field in fields} if fields else {}
+            if not col.overlay_data_ids():
+                # Pure-block dataset: hand back column copies directly.
+                names = fields if fields is not None else list(col.block_fields)
+                out: dict[str, list] = {}
+                for name in names:
+                    if name == ROW_ID:
+                        out[name] = list(range(col.block_start, col.block_stop))
+                    elif name in col.block_columns:
+                        out[name] = list(col.block_columns[name])
+                    else:
+                        out[name] = [None] * col.block_rows
+                return out
+        # Mixed block + overlay rows: fall back to the row-merge path.
+        return super().read_columns(collection, fields)
 
 
 _GLOBAL_STORE: Optional[InMemoryStore] = None

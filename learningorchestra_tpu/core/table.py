@@ -162,6 +162,20 @@ class ColumnTable:
         return np.stack([self.columns[f] for f in fields], axis=1)
 
     # --- store round-trip -----------------------------------------------------
+    def value_columns(self) -> dict[str, list]:
+        """Columns as plain Python lists with the store's missing-value
+        convention (numeric NaN → ``None``) — the shape
+        ``DocumentStore.insert_columns`` takes."""
+        out: dict[str, list] = {}
+        for name, column in self.columns.items():
+            if column.dtype == np.float64:
+                out[name] = [
+                    None if np.isnan(value) else float(value) for value in column
+                ]
+            else:
+                out[name] = column.tolist()
+        return out
+
     def documents(self, start_id: int = 1) -> list[dict]:
         """Row-major view as store documents with ``_id`` ``start_id..``."""
         names = self.field_names
@@ -182,6 +196,36 @@ class ColumnTable:
 BATCH_SIZE = 4096
 
 
+def _write_initial_metadata(store: DocumentStore, collection: str, meta: dict) -> None:
+    initial = dict(meta)
+    initial["finished"] = False
+    store.insert_one(collection, initial)
+
+
+def num_column_rows(columns: dict[str, list]) -> int:
+    return len(next(iter(columns.values()))) if columns else 0
+
+
+def insert_columns_batched(
+    store: DocumentStore,
+    collection: str,
+    columns: dict[str, list],
+    start_id: int = 1,
+    batch_size: int = BATCH_SIZE,
+) -> int:
+    """Append ``columns`` as rows ``start_id..`` in ``batch_size`` slices
+    (bounds per-call WAL record / wire message sizes). Returns the row
+    count. The one batching loop every columnar writer shares."""
+    num_rows = num_column_rows(columns)
+    for start in range(0, num_rows, batch_size):
+        store.insert_columns(
+            collection,
+            {name: values[start : start + batch_size] for name, values in columns.items()},
+            start_id=start_id + start,
+        )
+    return num_rows
+
+
 def write_documents(
     store: DocumentStore,
     collection: str,
@@ -191,20 +235,55 @@ def write_documents(
 ) -> None:
     """Write row documents plus an ``_id: 0`` metadata document.
 
-    The single authoritative implementation of the ``finished``-flag wire
-    contract: the metadata document is inserted with ``finished: false``
-    first, rows land in ``insert_many`` batches, and the caller's final
-    metadata (including ``finished: true`` if requested) is applied only
-    after the last row — so a concurrent poller never observes a
-    "finished" dataset with partial rows.
+    The ``finished``-flag wire contract: the metadata document is
+    inserted with ``finished: false`` first, rows land in ``insert_many``
+    batches, and the caller's final metadata (including ``finished:
+    true`` if requested) is applied only after the last row — so a
+    concurrent poller never observes a "finished" dataset with partial
+    rows.
     """
     meta = dict(metadata)
     meta[ROW_ID] = METADATA_ID
-    initial = dict(meta)
-    initial["finished"] = False
-    store.insert_one(collection, initial)
+    _write_initial_metadata(store, collection, meta)
     for start in range(0, len(documents), batch_size):
         store.insert_many(collection, documents[start : start + batch_size])
+    store.update_one(collection, {ROW_ID: METADATA_ID}, meta)
+
+
+def write_columns(
+    store: DocumentStore,
+    collection: str,
+    columns: dict[str, list],
+    metadata: dict,
+    ids: Optional[Sequence] = None,
+    batch_size: int = BATCH_SIZE,
+) -> None:
+    """Write a dataset column-major under the same ``finished`` contract
+    as :func:`write_documents` — the fast path: the store keeps the body
+    as a columnar block, no per-row dicts anywhere.
+
+    ``ids`` (when given) must be the contiguous ``1..N`` range a block
+    requires; non-contiguous ids take the row-document fallback.
+    """
+    num_rows = num_column_rows(columns)
+    meta = dict(metadata)
+    meta[ROW_ID] = METADATA_ID
+
+    contiguous_start = 1
+    if ids is not None:
+        first = int(ids[0]) if num_rows else 1
+        if any(int(ids[i]) != first + i for i in range(num_rows)):
+            documents = []
+            for i in range(num_rows):
+                document = {name: values[i] for name, values in columns.items()}
+                document[ROW_ID] = ids[i]
+                documents.append(document)
+            write_documents(store, collection, documents, metadata, batch_size)
+            return
+        contiguous_start = first
+
+    _write_initial_metadata(store, collection, meta)
+    insert_columns_batched(store, collection, columns, contiguous_start, batch_size)
     store.update_one(collection, {ROW_ID: METADATA_ID}, meta)
 
 
@@ -216,5 +295,5 @@ def write_table(
     batch_size: int = BATCH_SIZE,
 ) -> None:
     """Write a :class:`ColumnTable` to the store under the ``finished``
-    contract (see :func:`write_documents`)."""
-    write_documents(store, collection, table.documents(), metadata, batch_size)
+    contract, column-major (see :func:`write_columns`)."""
+    write_columns(store, collection, table.value_columns(), metadata, batch_size=batch_size)

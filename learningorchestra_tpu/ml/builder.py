@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from learningorchestra_tpu.core.store import DocumentStore, ROW_ID
-from learningorchestra_tpu.core.table import BATCH_SIZE, ColumnTable
+from learningorchestra_tpu.core.table import ColumnTable, insert_columns_batched
 from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
@@ -40,28 +40,25 @@ def load_dataframe(store: DocumentStore, filename: str) -> DataFrame:
     return DataFrame.from_table(ColumnTable.from_store(store, filename))
 
 
-def _prediction_documents(predicted_df: DataFrame) -> list[dict]:
-    """Row documents from a prediction frame: every column except the
+def _prediction_columns(predicted_df: DataFrame) -> dict[str, list]:
+    """Column-major view of a prediction frame: every column except the
     assembled ``features`` vector (the reference also deletes
-    ``rawPrediction``, which we never materialize), ``probability`` as a
-    plain list (reference model_builder.py:232-247)."""
-    names = [n for n in predicted_df.columns if n != FEATURES_COL]
-    columns = {n: predicted_df._column(n) for n in names}
-    documents = []
-    for i in range(predicted_df.count()):
-        document = {}
-        for name in names:
-            column = columns[name]
-            if column.ndim > 1:
-                document[name] = [float(v) for v in column[i]]
-            elif column.dtype == object:
-                document[name] = column[i]
-            else:
-                value = float(column[i])
-                document[name] = None if np.isnan(value) else value
-        document[ROW_ID] = i + 1
-        documents.append(document)
-    return documents
+    ``rawPrediction``, which we never materialize), ``probability`` as
+    per-row plain lists (reference model_builder.py:232-247)."""
+    out: dict[str, list] = {}
+    for name in predicted_df.columns:
+        if name == FEATURES_COL:
+            continue
+        column = predicted_df._column(name)
+        if column.ndim > 1:
+            out[name] = [[float(v) for v in row] for row in column]
+        elif column.dtype == object:
+            out[name] = column.tolist()
+        else:
+            out[name] = [
+                None if np.isnan(value) else float(value) for value in column
+            ]
+    return out
 
 
 def train_one(
@@ -116,9 +113,7 @@ def train_one(
     # 191-196; document shape shown in docs/database_api.md:76-83).
     store.drop(output_name)
     store.insert_one(output_name, metadata)
-    documents = _prediction_documents(predicted_df)
-    for start in range(0, len(documents), BATCH_SIZE):
-        store.insert_many(output_name, documents[start : start + BATCH_SIZE])
+    insert_columns_batched(store, output_name, _prediction_columns(predicted_df))
     return metadata
 
 

@@ -203,6 +203,27 @@ def _strings_column(cells: list[str]) -> np.ndarray:
     return out
 
 
+def read_csv_raw_columns(path: str) -> Optional[tuple[list[str], list[list[str]]]]:
+    """Header plus every column as raw cell strings (``""`` for empty) —
+    the ingest contract, which stores values untyped (reference:
+    microservices/database_api_image/database.py:156-169; the fieldtypes
+    service converts later). Returns ``None`` when the native parser is
+    unavailable or rejects the file (caller falls back to Python)."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    try:
+        parsed = NativeCsv(path)
+    except OSError:
+        return None
+    with parsed:
+        header = parsed.header()
+        columns = [
+            parsed.string_column(j).tolist() for j in range(parsed.num_cols)
+        ]
+    return header, columns
+
+
 def read_csv_columns(path: str) -> dict[str, np.ndarray]:
     """CSV → columns: float64 (NaN for empty) where every cell parses as
     a number, object strings otherwise. Native when available, Python

@@ -55,10 +55,19 @@ def convert_field_types(
         filename, fields=[ROW_ID] + list(field_types)
     )
     ids = columns[ROW_ID]
+    num_rows = len(ids)
+    contiguous = num_rows == 0 or all(
+        ids[i] == ids[0] + i for i in range(num_rows)
+    )
     for field, field_type in field_types.items():
         convert = converters[field_type]
-        store.set_field_values(
-            filename,
-            field,
-            {doc_id: convert(value) for doc_id, value in zip(ids, columns[field])},
-        )
+        converted = [convert(value) for value in columns[field]]
+        if contiguous:
+            # one bulk column write (block-replace fast path in the store)
+            store.set_column(
+                filename, field, converted, start_id=ids[0] if num_rows else 1
+            )
+        else:
+            store.set_field_values(
+                filename, field, dict(zip(ids, converted))
+            )

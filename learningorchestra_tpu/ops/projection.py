@@ -8,18 +8,18 @@ flips when the job completes.
 
 Here projection is a single bulk columnar move: one ``read_columns`` scan
 (fields + ``_id`` together, so values and row ids can never mis-pair) and
-one batched write under the ``finished`` contract. Row ``_id``s are
-preserved, matching the reference's appending of ``_id`` to the
-projection fields (projection_image/server.py:104-106). Values are copied
-raw — projection never coerces types; that is the fieldtypes service's
-job.
+one column-major write under the ``finished`` contract — column lists in,
+column lists out, no per-row dicts. Row ``_id``s are preserved, matching
+the reference's appending of ``_id`` to the projection fields
+(projection_image/server.py:104-106). Values are copied raw — projection
+never coerces types; that is the fieldtypes service's job.
 """
 
 from __future__ import annotations
 
 from learningorchestra_tpu.core.ingest import timestamp
 from learningorchestra_tpu.core.store import ROW_ID, DocumentStore
-from learningorchestra_tpu.core.table import write_documents
+from learningorchestra_tpu.core.table import write_columns
 
 
 def project(
@@ -45,16 +45,10 @@ def project(
     ids = columns.pop(ROW_ID)
     num_rows = len(ids)
 
-    documents = []
-    for i in range(num_rows):
-        document = {name: columns[name][i] for name in field_names}
-        document[ROW_ID] = ids[i]
-        documents.append(document)
-
-    write_documents(
+    write_columns(
         store,
         projection_filename,
-        documents,
+        columns,
         {
             "filename": projection_filename,
             "finished": True,
@@ -62,5 +56,6 @@ def project(
             "parent_filename": parent_filename,
             "fields": field_names,
         },
+        ids=ids,
     )
     return num_rows

@@ -250,3 +250,147 @@ def test_ingest_claim_shares_create_collection_gate(store, titanic_csv):
     assert store.create_collection("claimed")
     with pytest.raises(KeyError):
         write_ingest_metadata(store, "claimed", titanic_csv)
+
+
+class TestColumnarBlock:
+    def test_insert_columns_roundtrip_find(self, store):
+        store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": False})
+        store.insert_columns("ds", {"a": ["1", "2", "3"], "b": ["x", "y", "z"]})
+        docs = list(store.find("ds"))
+        assert [d[ROW_ID] for d in docs] == [0, 1, 2, 3]
+        assert docs[1] == {"a": "1", "b": "x", ROW_ID: 1}
+        assert docs[3] == {"a": "3", "b": "z", ROW_ID: 3}
+        assert store.count("ds") == 4
+
+    def test_insert_columns_appends_contiguously(self, store):
+        store.insert_columns("ds", {"a": [1, 2]})
+        store.insert_columns("ds", {"a": [3, 4]})  # start inferred = 3
+        assert store.read_columns("ds", ["a", ROW_ID]) == {
+            "a": [1, 2, 3, 4],
+            ROW_ID: [1, 2, 3, 4],
+        }
+        with pytest.raises(ValueError):
+            store.insert_columns("ds", {"a": [9]}, start_id=99)
+
+    def test_insert_columns_ragged_rejected(self, store):
+        with pytest.raises(ValueError):
+            store.insert_columns("ds", {"a": [1], "b": [1, 2]})
+
+    def test_insert_columns_overlay_collision(self, store):
+        store.insert_one("ds", {ROW_ID: 2, "x": "row"})
+        with pytest.raises(KeyError):
+            store.insert_columns("ds", {"a": [1, 2, 3]}, start_id=1)
+
+    def test_insert_one_into_block_range_rejected(self, store):
+        store.insert_columns("ds", {"a": [1, 2, 3]})
+        with pytest.raises(KeyError):
+            store.insert_one("ds", {ROW_ID: 2, "a": 9})
+        # append after the block auto-assigns the next id
+        store.insert_one("ds", {"a": 4})
+        assert store.find_one("ds", {"a": 4})[ROW_ID] == 4
+
+    def test_block_field_update_and_set_field(self, store):
+        store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": False})
+        store.insert_columns("ds", {"a": ["1", "2"]})
+        store.update_one("ds", {ROW_ID: 1}, {"a": "9", "new": "n"})
+        assert store.find_one("ds", {ROW_ID: 1}) == {
+            "a": "9",
+            "new": "n",
+            ROW_ID: 1,
+        }
+        assert store.find_one("ds", {ROW_ID: 2})["new"] is None
+        store.set_field_values("ds", "a", {1: 10, 2: 20})
+        assert store.read_columns("ds", ["a"]) == {"a": [10, 20]}
+        # metadata (overlay) survives untouched
+        assert store.metadata("ds")["finished"] is False
+
+    def test_generic_query_update_hits_block_row(self, store):
+        store.insert_columns("ds", {"a": ["x", "y", "y"]})
+        store.update_one("ds", {"a": "y"}, {"a": "z"})  # first match only
+        assert store.read_columns("ds", ["a"]) == {"a": ["x", "z", "y"]}
+
+    def test_read_columns_mixed_overlay_fallback(self, store):
+        store.insert_columns("ds", {"a": [1, 2]})
+        store.insert_one("ds", {ROW_ID: 10, "a": 5})  # stray overlay row
+        assert store.read_columns("ds", ["a"]) == {"a": [1, 2, 5]}
+
+    def test_wal_replays_columnar_block(self, tmp_path):
+        data_dir = str(tmp_path / "wal")
+        first = InMemoryStore(data_dir=data_dir)
+        first.insert_one("ds", {ROW_ID: METADATA_ID, "finished": True})
+        first.insert_columns("ds", {"a": ["1", "2"]})
+        second = InMemoryStore(data_dir=data_dir)
+        assert list(second.find("ds", {ROW_ID: {"$gt": 0}})) == [
+            {"a": "1", ROW_ID: 1},
+            {"a": "2", ROW_ID: 2},
+        ]
+        # and through compaction
+        second.compact()
+        third = InMemoryStore(data_dir=data_dir)
+        assert third.read_columns("ds", ["a"]) == {"a": ["1", "2"]}
+        assert third.metadata("ds")["finished"] is True
+
+    def test_aggregate_group_fast_path(self, store):
+        store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": True})
+        store.insert_columns("ds", {"s": ["a", "b", "a", None]})
+        result = store.aggregate("ds", [{"$group": {"_id": "$s", "count": {"$sum": 1}}}])
+        assert {r["_id"]: r["count"] for r in result} == {"a": 2, "b": 1, None: 1}
+
+    def test_pagination_on_block(self, store):
+        store.insert_columns("ds", {"a": list(range(100))})
+        docs = list(store.find("ds", skip=95, limit=10))
+        assert [d[ROW_ID] for d in docs] == [96, 97, 98, 99, 100]
+
+
+def test_set_column_block_replace_and_wal(tmp_path):
+    data_dir = str(tmp_path / "wal")
+    store = InMemoryStore(data_dir=data_dir)
+    store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": True})
+    store.insert_columns("ds", {"a": ["1", "2", "3"]})
+    store.set_column("ds", "a", [1, 2, 3])
+    store.set_column("ds", "b", ["x", "y", "z"])  # brand-new field
+    assert store.read_columns("ds", ["a", "b"]) == {
+        "a": [1, 2, 3],
+        "b": ["x", "y", "z"],
+    }
+    replayed = InMemoryStore(data_dir=data_dir)
+    assert replayed.read_columns("ds", ["a", "b"]) == {
+        "a": [1, 2, 3],
+        "b": ["x", "y", "z"],
+    }
+
+
+def test_set_column_partial_range(store):
+    store.insert_columns("ds", {"a": [0, 0, 0, 0]})
+    store.set_column("ds", "a", [7, 8], start_id=2)
+    assert store.read_columns("ds", ["a"]) == {"a": [0, 7, 8, 0]}
+
+
+def test_insert_columns_rejects_id_column(store):
+    with pytest.raises(ValueError):
+        store.insert_columns("ds", {"_id": [5, 6], "a": [1, 2]})
+
+
+def test_update_one_operator_query_on_id(store):
+    store.insert_columns("ds", {"a": ["x", "y", "z"]})
+    store.update_one("ds", {ROW_ID: {"$gt": 2}}, {"a": "Z"})
+    assert store.read_columns("ds", ["a"]) == {"a": ["x", "y", "Z"]}
+
+
+def test_aggregate_group_by_id_fast_path(store):
+    store.insert_columns("ds", {"a": ["x", "y"]})
+    result = store.aggregate("ds", [{"$group": {"_id": "$_id", "count": {"$sum": 1}}}])
+    assert sorted((r["_id"], r["count"]) for r in result) == [(1, 1), (2, 1)]
+
+
+def test_ingest_csv_with_id_header_column(store, tmp_path):
+    from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
+
+    path = tmp_path / "withid.csv"
+    path.write_text("_id,name\n99,alice\n98,bob\n")
+    write_ingest_metadata(store, "w", str(path))
+    ingest_csv(store, "w", str(path))
+    rows = list(store.find("w", {ROW_ID: {"$gt": 0}}))
+    # CSV _id column discarded; row ids are always 1..N (reference parity)
+    assert [r[ROW_ID] for r in rows] == [1, 2]
+    assert [r["name"] for r in rows] == ["alice", "bob"]
