@@ -133,6 +133,8 @@ def _match_operators(document: dict, key: str, ops: dict) -> bool:
             if _match_operators(document, key, operand):
                 return False
         else:
+            if op not in ("$eq", "$gt", "$gte", "$lt", "$lte", "$in"):
+                raise UnsupportedQueryError(f"unsupported query operator {op!r}")
             if op == "$in":
                 operand = _membership_list(op, operand)  # validate even if absent
             if not present or not _compare(op, value, operand):
@@ -335,6 +337,23 @@ def _is_int_id(doc_id: Any) -> bool:
     return isinstance(doc_id, int) and not isinstance(doc_id, bool)
 
 
+class _Missing:
+    """Pad value for block rows that genuinely lack a field (a field
+    added after the block was written). Distinct from ``None`` (an
+    explicit null) so synthesized documents keep Mongo's missing-field
+    semantics ($exists, $ne on absent fields, equality-with-None).
+    Never serialized: the WAL logs only caller-supplied values, and
+    replaying the same ops reproduces the same pads."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<missing>"
+
+
+_MISSING = _Missing()
+
+
 class _Collection:
     """One collection's storage: a contiguous column-major block for the
     dataset body plus a row-document overlay for everything else.
@@ -347,13 +366,33 @@ class _Collection:
     and any out-of-band inserts. Ids never overlap between the two.
     """
 
-    __slots__ = ("block_fields", "block_columns", "block_start", "rows")
+    __slots__ = (
+        "block_fields",
+        "block_columns",
+        "block_start",
+        "rows",
+        "padded_fields",
+    )
 
     def __init__(self):
         self.block_fields: list[str] = []
         self.block_columns: dict[str, list] = {}
         self.block_start = 1
         self.rows: dict[Any, dict] = {}
+        # fields whose columns may contain _MISSING pads
+        self.padded_fields: set[str] = set()
+
+    def snapshot(self) -> "_Collection":
+        """A cheap read view: copied field/row maps, shared column and
+        document references — lets ``find`` yield outside the store
+        lock without materializing the result set."""
+        clone = _Collection()
+        clone.block_fields = list(self.block_fields)
+        clone.block_columns = dict(self.block_columns)
+        clone.block_start = self.block_start
+        clone.rows = dict(self.rows)
+        clone.padded_fields = set(self.padded_fields)
+        return clone
 
     # --- block geometry -------------------------------------------------------
     @property
@@ -383,7 +422,11 @@ class _Collection:
     # --- row synthesis --------------------------------------------------------
     def block_document(self, doc_id: int) -> dict:
         i = doc_id - self.block_start
-        document = {name: self.block_columns[name][i] for name in self.block_fields}
+        document = {}
+        for name in self.block_fields:
+            value = self.block_columns[name][i]
+            if value is not _MISSING:
+                document[name] = value
         document[ROW_ID] = doc_id
         return document
 
@@ -415,9 +458,11 @@ class _Collection:
             raise KeyError("_id is not a block field")
         column = self.block_columns.get(field)
         if column is None:
-            column = [None] * self.block_rows
+            column = [_MISSING] * self.block_rows
             self.block_columns[field] = column
             self.block_fields.append(field)
+            if self.block_rows:
+                self.padded_fields.add(field)
         return column
 
     def set_block_values(self, doc_id: int, new_values: dict) -> None:
@@ -444,9 +489,13 @@ class _Collection:
                 raise KeyError(f"duplicate _id {doc_id!r}")
         for field in fields:
             self.ensure_block_field(field)
-        pad = [None] * num_new
+        pad = [_MISSING] * num_new
         for field, column in self.block_columns.items():
-            column.extend(columns[field] if field in columns else pad)
+            if field in columns:
+                column.extend(columns[field])
+            else:
+                column.extend(pad)
+                self.padded_fields.add(field)
 
 
 class InMemoryStore(DocumentStore):

@@ -1,0 +1,334 @@
+"""The store as a network service: HTTP wire protocol + client backend.
+
+The reference's only data plane is a MongoDB replica set every service
+container points at via ``DATABASE_URL`` (reference:
+docker-compose.yml:27-91 replica set, :188-192 per-service env). This
+module is that role for the TPU framework: a store server process
+exposing the full :class:`DocumentStore` interface over HTTP, and
+:class:`RemoteStore`, the client backend the seven services use to run
+as independent processes/containers against one shared store.
+
+Wire protocol (JSON bodies; both ends are this module, so it is an
+internal contract, versioned by the framework):
+
+- ``GET  /collections``                         → ``{"collections": [...]}``
+- ``POST /collections/<name>``                  → ``{"created": bool}`` (atomic claim)
+- ``DELETE /collections/<name>``                → ``{}``
+- ``POST /c/<name>/insert_one``     ``{"document": {...}}``
+- ``POST /c/<name>/insert_many``    ``{"documents": [...]}``
+- ``POST /c/<name>/insert_columns`` ``{"columns": {...}, "start_id": n|null}``
+- ``POST /c/<name>/update_one``     ``{"query": {...}, "new_values": {...}}``
+- ``POST /c/<name>/set_field_values`` ``{"field": f, "values": [[id, v], ...]}``
+  (id/value pairs, not an object — JSON objects would stringify int ids)
+- ``POST /c/<name>/set_column``     ``{"field": f, "values": [...], "start_id": n}``
+- ``POST /c/<name>/find``           ``{"query", "skip", "limit"}`` → ``{"documents"}``
+- ``POST /c/<name>/read_columns``   ``{"fields": [...]|null}`` → ``{"columns"}``
+- ``POST /c/<name>/aggregate``      ``{"pipeline": [...]}`` → ``{"results"}``
+- ``GET  /c/<name>/count``                      → ``{"count": n}``
+- ``GET  /health``                              → ``{"ok": true}``
+
+Error mapping: ``KeyError`` (duplicate ids/collections) → 409;
+``UnsupportedQueryError`` → 400 with ``kind: unsupported_query``; other
+``ValueError`` → 400. :class:`RemoteStore` re-raises the same exception
+types, so service code behaves identically on a local or remote store.
+
+Durability/replication posture: the server runs one WAL-backed
+:class:`InMemoryStore` (SURVEY §2 notes replication is the external
+store's concern in the reference; here the WAL is the durability story
+and the server is the single writer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterator, Optional
+
+import requests
+
+from learningorchestra_tpu.core.store import (
+    DocumentStore,
+    InMemoryStore,
+    UnsupportedQueryError,
+)
+from learningorchestra_tpu.utils.web import ServerThread, WebApp
+
+DEFAULT_STORE_PORT = 27027
+
+
+def create_store_app(store: DocumentStore) -> WebApp:
+    app = WebApp("store")
+
+    def guarded(handler):
+        def wrapped(request, **kwargs):
+            try:
+                return handler(request, **kwargs)
+            except KeyError as error:
+                return {"error": str(error)}, 409
+            except UnsupportedQueryError as error:
+                return {"error": str(error), "kind": "unsupported_query"}, 400
+            except ValueError as error:
+                return {"error": str(error)}, 400
+
+        wrapped.__name__ = handler.__name__
+        return wrapped
+
+    @app.route("/health", methods=("GET",))
+    def health(request):
+        return {"ok": True}, 200
+
+    @app.route("/collections", methods=("GET",))
+    def list_collections(request):
+        return {"collections": store.list_collections()}, 200
+
+    @app.route("/collections/<name>", methods=("POST",))
+    def create_collection(request, name):
+        return {"created": store.create_collection(name)}, 200
+
+    @app.route("/collections/<name>", methods=("DELETE",))
+    def drop(request, name):
+        store.drop(name)
+        return {}, 200
+
+    @app.route("/c/<name>/insert_one", methods=("POST",))
+    @guarded
+    def insert_one(request, name):
+        store.insert_one(name, request.get_json()["document"])
+        return {}, 200
+
+    @app.route("/c/<name>/insert_many", methods=("POST",))
+    @guarded
+    def insert_many(request, name):
+        store.insert_many(name, request.get_json()["documents"])
+        return {}, 200
+
+    @app.route("/c/<name>/insert_columns", methods=("POST",))
+    @guarded
+    def insert_columns(request, name):
+        body = request.get_json()
+        store.insert_columns(name, body["columns"], start_id=body.get("start_id"))
+        return {}, 200
+
+    @app.route("/c/<name>/update_one", methods=("POST",))
+    @guarded
+    def update_one(request, name):
+        body = request.get_json()
+        store.update_one(name, body["query"], body["new_values"])
+        return {}, 200
+
+    @app.route("/c/<name>/set_field_values", methods=("POST",))
+    @guarded
+    def set_field_values(request, name):
+        body = request.get_json()
+        store.set_field_values(name, body["field"], dict(body["values"]))
+        return {}, 200
+
+    @app.route("/c/<name>/set_column", methods=("POST",))
+    @guarded
+    def set_column(request, name):
+        body = request.get_json()
+        store.set_column(
+            name, body["field"], body["values"], start_id=body.get("start_id", 1)
+        )
+        return {}, 200
+
+    @app.route("/c/<name>/find", methods=("POST",))
+    @guarded
+    def find(request, name):
+        body = request.get_json()
+        documents = list(
+            store.find(
+                name,
+                body.get("query") or {},
+                skip=body.get("skip", 0),
+                limit=body.get("limit"),
+            )
+        )
+        return {"documents": documents}, 200
+
+    @app.route("/c/<name>/read_columns", methods=("POST",))
+    @guarded
+    def read_columns(request, name):
+        columns = store.read_columns(name, request.get_json().get("fields"))
+        return {"columns": columns}, 200
+
+    @app.route("/c/<name>/aggregate", methods=("POST",))
+    @guarded
+    def aggregate(request, name):
+        try:
+            results = store.aggregate(name, request.get_json()["pipeline"])
+        except NotImplementedError as error:
+            return {"error": str(error)}, 400
+        return {"results": results}, 200
+
+    @app.route("/c/<name>/count", methods=("GET",))
+    def count(request, name):
+        return {"count": store.count(name)}, 200
+
+    return app
+
+
+class RemoteStore(DocumentStore):
+    """A :class:`DocumentStore` over the store server's wire protocol.
+
+    Drop-in for :class:`InMemoryStore` in every service — this is what
+    turns the single-process runner into the reference's seven
+    independent containers sharing one database (reference:
+    docker-compose.yml:173-330)."""
+
+    def __init__(self, base_url: str, timeout: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._local = threading.local()
+
+    # one session per thread: requests.Session pools connections but is
+    # not formally thread-safe
+    @property
+    def _session(self) -> requests.Session:
+        session = getattr(self._local, "session", None)
+        if session is None:
+            session = requests.Session()
+            self._local.session = session
+        return session
+
+    def _raise_for(self, response) -> None:
+        if response.status_code == 409:
+            raise KeyError(response.json().get("error", "duplicate"))
+        if response.status_code == 400:
+            payload = response.json()
+            if payload.get("kind") == "unsupported_query":
+                raise UnsupportedQueryError(payload.get("error", "bad query"))
+            raise ValueError(payload.get("error", "bad request"))
+        response.raise_for_status()
+
+    def _post(self, path: str, body: dict) -> dict:
+        response = self._session.post(
+            f"{self.base_url}{path}",
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+            timeout=self.timeout,
+        )
+        self._raise_for(response)
+        return response.json()
+
+    def _get(self, path: str) -> dict:
+        response = self._session.get(f"{self.base_url}{path}", timeout=self.timeout)
+        self._raise_for(response)
+        return response.json()
+
+    def _delete(self, path: str) -> dict:
+        response = self._session.delete(f"{self.base_url}{path}", timeout=self.timeout)
+        self._raise_for(response)
+        return response.json()
+
+    # --- DocumentStore implementation -----------------------------------------
+    def list_collections(self) -> list[str]:
+        return self._get("/collections")["collections"]
+
+    def create_collection(self, collection: str) -> bool:
+        return self._post(f"/collections/{collection}", {})["created"]
+
+    def drop(self, collection: str) -> None:
+        self._delete(f"/collections/{collection}")
+
+    def insert_one(self, collection: str, document: dict) -> None:
+        self._post(f"/c/{collection}/insert_one", {"document": document})
+
+    def insert_many(self, collection: str, documents: list[dict]) -> None:
+        self._post(f"/c/{collection}/insert_many", {"documents": documents})
+
+    def insert_columns(
+        self,
+        collection: str,
+        columns: dict[str, list],
+        start_id: Optional[int] = None,
+    ) -> None:
+        self._post(
+            f"/c/{collection}/insert_columns",
+            {"columns": columns, "start_id": start_id},
+        )
+
+    def update_one(self, collection: str, query: dict, new_values: dict) -> None:
+        self._post(
+            f"/c/{collection}/update_one",
+            {"query": query, "new_values": new_values},
+        )
+
+    def set_field_values(
+        self, collection: str, field: str, values_by_id: dict
+    ) -> None:
+        self._post(
+            f"/c/{collection}/set_field_values",
+            {"field": field, "values": list(values_by_id.items())},
+        )
+
+    def set_column(
+        self, collection: str, field: str, values: list, start_id: int = 1
+    ) -> None:
+        self._post(
+            f"/c/{collection}/set_column",
+            {"field": field, "values": values, "start_id": start_id},
+        )
+
+    def find(
+        self,
+        collection: str,
+        query: Optional[dict] = None,
+        skip: int = 0,
+        limit: Optional[int] = None,
+    ) -> Iterator[dict]:
+        payload = self._post(
+            f"/c/{collection}/find",
+            {"query": query or {}, "skip": skip, "limit": limit},
+        )
+        return iter(payload["documents"])
+
+    def read_columns(
+        self, collection: str, fields: Optional[list[str]] = None
+    ) -> dict[str, list]:
+        return self._post(f"/c/{collection}/read_columns", {"fields": fields})[
+            "columns"
+        ]
+
+    def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
+        return self._post(f"/c/{collection}/aggregate", {"pipeline": pipeline})[
+            "results"
+        ]
+
+    def count(self, collection: str) -> int:
+        return self._get(f"/c/{collection}/count")["count"]
+
+
+def connect(url: Optional[str] = None) -> DocumentStore:
+    """The services' store factory: a :class:`RemoteStore` when a store
+    URL is configured (``LO_STORE_URL`` — the analogue of the reference's
+    ``DATABASE_URL``), else a process-local WAL-backed store."""
+    url = url if url is not None else os.environ.get("LO_STORE_URL")
+    if url:
+        return RemoteStore(url)
+    data_dir = os.environ.get("LO_DATA_DIR")
+    return InMemoryStore(data_dir=data_dir)
+
+
+def serve(
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_STORE_PORT,
+    data_dir: Optional[str] = None,
+) -> ServerThread:
+    """Start a store server thread; returns it (caller stops)."""
+    store = InMemoryStore(data_dir=data_dir)
+    return ServerThread(create_store_app(store), host, port).start()
+
+
+def main() -> None:
+    host = os.environ.get("LO_HOST", "127.0.0.1")
+    port = int(os.environ.get("LO_STORE_PORT", DEFAULT_STORE_PORT))
+    data_dir = os.environ.get("LO_DATA_DIR")
+    server = serve(host, port, data_dir)
+    print(f"store server on {host}:{server.port} (data_dir={data_dir})", flush=True)
+    server._thread.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,13 +1,27 @@
-"""Serve the full service stack in one process.
+"""Serve the service stack: all seven in one process, or one per process.
 
 The reference deploys seven Flask containers wired to a shared MongoDB
-(docker-compose.yml); here the equivalent single-host bring-up is seven
-WSGI servers over one shared (WAL-backed) store. ``python -m
-learningorchestra_tpu.services.runner`` is the deployment entrypoint;
-``start_all`` is the programmatic/integration-test form.
+(docker-compose.yml:173-330). Both topologies exist here:
+
+- **single process** (default): seven WSGI servers over one in-process
+  WAL-backed store — ``python -m learningorchestra_tpu.services.runner``.
+- **one service per process** (the reference's microservice shape):
+  set ``LO_STORE_URL`` to a store server
+  (``python -m learningorchestra_tpu.core.store_service``) and launch
+  each service with ``LO_SERVICE=<name>`` — every process talks to the
+  shared store over its wire protocol, exactly as the reference
+  containers share Mongo via ``DATABASE_URL``.
 
 Environment:
-- ``LO_DATA_DIR`` — store WAL directory (default ``./lo_data``)
+- ``LO_SERVICE`` — serve only this service (``database_api``,
+  ``projection``, ``model_builder``, ``data_type_handler``,
+  ``histogram``, ``tsne``, ``pca``); unset = all seven
+- ``LO_PORT`` — bind port for single-service mode (default: the
+  service's reference port; ``0`` = OS-assigned, printed on stdout)
+- ``LO_STORE_URL`` — store server base URL (the reference's
+  ``DATABASE_URL`` analogue); unset = in-process store
+- ``LO_DATA_DIR`` — store WAL directory for the in-process store
+  (default ``./lo_data``)
 - ``LO_IMAGES_DIR`` — PNG volume root (default ``<data>/images``)
 - ``LO_HOST`` — bind host. Defaults to ``127.0.0.1``: the model-builder
   service executes request-supplied preprocessor code (reference parity),
@@ -43,19 +57,37 @@ from learningorchestra_tpu.services import (
 from learningorchestra_tpu.utils.web import ServerThread
 
 
+SERVICES: dict[str, int] = {
+    "database_api": DATABASE_API_PORT,
+    "projection": PROJECTION_PORT,
+    "model_builder": MODEL_BUILDER_PORT,
+    "data_type_handler": DATA_TYPE_HANDLER_PORT,
+    "histogram": HISTOGRAM_PORT,
+    "tsne": TSNE_PORT,
+    "pca": PCA_PORT,
+}
+
+
+def build_app(name: str, store: DocumentStore, images_dir: str):
+    if name == "database_api":
+        return database_api.create_app(store, JobManager())
+    if name == "projection":
+        return projection.create_app(store)
+    if name == "model_builder":
+        return model_builder.create_app(store)
+    if name == "data_type_handler":
+        return data_type_handler.create_app(store)
+    if name == "histogram":
+        return histogram.create_app(store)
+    if name in ("tsne", "pca"):
+        return images.create_app(store, os.path.join(images_dir, name), name)
+    raise KeyError(f"unknown service {name!r}")
+
+
 def build_apps(store: DocumentStore, images_dir: str) -> dict[int, object]:
     return {
-        DATABASE_API_PORT: database_api.create_app(store, JobManager()),
-        PROJECTION_PORT: projection.create_app(store),
-        MODEL_BUILDER_PORT: model_builder.create_app(store),
-        DATA_TYPE_HANDLER_PORT: data_type_handler.create_app(store),
-        HISTOGRAM_PORT: histogram.create_app(store),
-        TSNE_PORT: images.create_app(
-            store, os.path.join(images_dir, "tsne"), "tsne"
-        ),
-        PCA_PORT: images.create_app(
-            store, os.path.join(images_dir, "pca"), "pca"
-        ),
+        port: build_app(name, store, images_dir)
+        for name, port in SERVICES.items()
     }
 
 
@@ -83,18 +115,34 @@ def start_all(
 
 
 def main() -> None:
+    from learningorchestra_tpu.core.store_service import connect
+
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     images_dir = os.environ.get(
         "LO_IMAGES_DIR", os.path.join(data_dir, "images")
     )
     host = os.environ.get("LO_HOST", "127.0.0.1")
-    store = InMemoryStore(data_dir=data_dir)
-    _, servers = start_all(store, images_dir, host)
-    print(
-        f"learningorchestra_tpu serving on ports 5000-5006 (host {host}); "
-        f"data in {data_dir}",
-        flush=True,
-    )
+    store_url = os.environ.get("LO_STORE_URL")
+    service = os.environ.get("LO_SERVICE")
+
+    if store_url:
+        store = connect(store_url)
+    else:
+        store = InMemoryStore(data_dir=data_dir)
+
+    if service:
+        port = int(os.environ.get("LO_PORT", SERVICES[service]))
+        server = ServerThread(build_app(service, store, images_dir), host, port)
+        server.start()
+        print(f"service {service} on {host}:{server.port}", flush=True)
+        servers = [server]
+    else:
+        _, servers = start_all(store, images_dir, host)
+        print(
+            f"learningorchestra_tpu serving on ports 5000-5006 (host {host}); "
+            f"data in {data_dir}",
+            flush=True,
+        )
     try:
         for server in servers:
             server._thread.join()
