@@ -342,8 +342,11 @@ class _Missing:
     added after the block was written). Distinct from ``None`` (an
     explicit null) so synthesized documents keep Mongo's missing-field
     semantics ($exists, $ne on absent fields, equality-with-None).
-    Never serialized: the WAL logs only caller-supplied values, and
-    replaying the same ops reproduces the same pads."""
+    Never escapes the store: live WAL records log only caller-supplied
+    values (replay reproduces pads), compaction snapshots serialize pads
+    as null + an index mask (``compact``), and the columnar fast paths
+    map pads to ``None`` on the way out (``read_columns``,
+    ``aggregate``)."""
 
     __slots__ = ()
 
@@ -383,14 +386,18 @@ class _Collection:
         self.padded_fields: set[str] = set()
 
     def snapshot(self) -> "_Collection":
-        """A cheap read view: copied field/row maps, shared column and
-        document references — lets ``find`` yield outside the store
-        lock without materializing the result set."""
+        """A consistent read view: column lists and overlay documents are
+        shallow-copied (O(rows) pointer copies — far cheaper than row
+        synthesis) so ``find`` can yield outside the store lock without
+        seeing concurrent mutations tear a document mid-iteration. Must
+        be called while holding the store lock."""
         clone = _Collection()
         clone.block_fields = list(self.block_fields)
-        clone.block_columns = dict(self.block_columns)
+        clone.block_columns = {
+            name: list(column) for name, column in self.block_columns.items()
+        }
         clone.block_start = self.block_start
-        clone.rows = dict(self.rows)
+        clone.rows = {doc_id: dict(row) for doc_id, row in self.rows.items()}
         clone.padded_fields = set(self.padded_fields)
         return clone
 
@@ -538,7 +545,8 @@ class InMemoryStore(DocumentStore):
                         self._apply_insert(record["c"], document)
                 elif op == "insert_cols":
                     self._apply_insert_columns(
-                        record["c"], record["d"], record["s"]
+                        record["c"], record["d"], record["s"],
+                        missing=record.get("m"),
                     )
                 elif op == "update":
                     self._apply_update(record["c"], record["q"], record["v"])
@@ -558,34 +566,78 @@ class InMemoryStore(DocumentStore):
                     self._collections.pop(record["c"], None)
 
     def compact(self) -> None:
+        """Rewrite the WAL as a snapshot.
+
+        Crash-safe: the snapshot is written to a temp file and
+        ``os.replace``d over ``wal.jsonl``, so a failed compaction leaves
+        the old log intact. ``_Missing`` pads (rows that never got a
+        later-added field) are serialized explicitly as null + a
+        missing-index mask (the ``"m"`` key) — they can't round-trip as
+        raw values because JSON has no missing/null distinction.
+        """
         with self._lock:
             if self._wal is None:
                 return
             path = self._wal.name
+            tmp_path = path + ".compact.tmp"
+            try:
+                with open(tmp_path, "w", encoding="utf-8") as handle:
+                    self._write_snapshot(handle)
+                    handle.flush()
+                    os.fsync(handle.fileno())  # data durable before rename
+            except BaseException:
+                try:
+                    os.remove(tmp_path)
+                except OSError:
+                    pass
+                raise
             self._wal.close()
-            with open(path, "w", encoding="utf-8") as handle:
-                for name, col in self._collections.items():
-                    handle.write(json.dumps({"op": "create", "c": name}) + "\n")
-                    if col.block_columns:
-                        handle.write(
-                            json.dumps(
-                                {
-                                    "op": "insert_cols",
-                                    "c": name,
-                                    "s": col.block_start,
-                                    "d": col.block_columns,
-                                }
-                            )
-                            + "\n"
-                        )
-                    if col.rows:
-                        handle.write(
-                            json.dumps(
-                                {"op": "insert_many", "c": name, "d": list(col.rows.values())}
-                            )
-                            + "\n"
-                        )
-            self._wal = open(path, "a", encoding="utf-8")
+            try:
+                os.replace(tmp_path, path)
+                directory_fd = os.open(
+                    os.path.dirname(path) or ".", os.O_RDONLY
+                )
+                try:
+                    os.fsync(directory_fd)  # make the rename itself durable
+                finally:
+                    os.close(directory_fd)
+            finally:
+                # Reopen whichever file now lives at `path` so later
+                # writes never hit a closed handle.
+                self._wal = open(path, "a", encoding="utf-8")
+
+    def _write_snapshot(self, handle) -> None:
+        for name, col in self._collections.items():
+            handle.write(json.dumps({"op": "create", "c": name}) + "\n")
+            if col.block_columns:
+                record = {
+                    "op": "insert_cols",
+                    "c": name,
+                    "s": col.block_start,
+                    "d": {},
+                }
+                missing: dict[str, list[int]] = {}
+                for field, column in col.block_columns.items():
+                    if field in col.padded_fields:
+                        indices = [
+                            i for i, v in enumerate(column) if v is _MISSING
+                        ]
+                        if indices:
+                            missing[field] = indices
+                            column = [
+                                None if v is _MISSING else v for v in column
+                            ]
+                    record["d"][field] = column
+                if missing:
+                    record["m"] = missing
+                handle.write(json.dumps(record) + "\n")
+            if col.rows:
+                handle.write(
+                    json.dumps(
+                        {"op": "insert_many", "c": name, "d": list(col.rows.values())}
+                    )
+                    + "\n"
+                )
 
     # --- primitive ops (no locking/logging) -----------------------------------
     def _apply_insert(self, collection: str, document: dict) -> None:
@@ -600,10 +652,23 @@ class InMemoryStore(DocumentStore):
         col.rows[doc_id] = dict(document)
 
     def _apply_insert_columns(
-        self, collection: str, columns: dict[str, list], start_id: int
+        self,
+        collection: str,
+        columns: dict[str, list],
+        start_id: int,
+        missing: Optional[dict] = None,
     ) -> None:
         col = self._collections.setdefault(collection, _Collection())
         col.append_columns(list(columns.keys()), columns, start_id)
+        if missing:  # snapshot replay: restore _Missing pads (see compact)
+            offset = start_id - col.block_start
+            for field, indices in missing.items():
+                column = col.block_columns.get(field)
+                if column is None:
+                    continue
+                for i in indices:
+                    column[offset + i] = _MISSING
+                col.padded_fields.add(field)
 
     def _apply_update(self, collection: str, query: dict, new_values: dict) -> None:
         col = self._collections.get(collection)
@@ -770,25 +835,32 @@ class InMemoryStore(DocumentStore):
         limit: Optional[int] = None,
     ) -> Iterator[dict]:
         query = query or {}
-        results: list[dict] = []
         with self._lock:
             col = self._collections.get(collection)
             if col is None:
                 return iter(())
+            # Snapshot under the lock (cheap: copied maps, shared column/
+            # document refs), synthesize row dicts outside it — an
+            # unlimited find over a large block no longer holds the store
+            # lock for O(rows) dict building.
+            view = col.snapshot()
+
+        def generate() -> Iterator[dict]:
             produced = 0
             skipped = 0
-            for doc_id in col.iter_ids():
-                document = col.document(doc_id)
+            for doc_id in view.iter_ids():
+                document = view.document(doc_id)
                 if not matches(document, query):
                     continue
                 if skipped < skip:
                     skipped += 1
                     continue
                 if limit is not None and produced >= limit:
-                    break
+                    return
                 produced += 1
-                results.append(document)
-        return iter(results)
+                yield document
+
+        return generate()
 
     def count(self, collection: str) -> int:
         with self._lock:
@@ -820,6 +892,11 @@ class InMemoryStore(DocumentStore):
                         values = col.block_columns.get(field)
                         if values is None:
                             values = [None] * col.block_rows
+                        elif field in col.padded_fields:
+                            # parity with the row path's document.get(field)
+                            values = [
+                                None if v is _MISSING else v for v in values
+                            ]
                     return [
                         {"_id": key, "count": count}
                         for key, count in Counter(values).items()
@@ -853,7 +930,14 @@ class InMemoryStore(DocumentStore):
                     if name == ROW_ID:
                         out[name] = list(range(col.block_start, col.block_stop))
                     elif name in col.block_columns:
-                        out[name] = list(col.block_columns[name])
+                        column = col.block_columns[name]
+                        if name in col.padded_fields:
+                            # parity with row.get(field): pads read as None
+                            out[name] = [
+                                None if v is _MISSING else v for v in column
+                            ]
+                        else:
+                            out[name] = list(column)
                     else:
                         out[name] = [None] * col.block_rows
                 return out

@@ -85,6 +85,12 @@ def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
             return {MESSAGE_RESULT: error.args[0]}, 406
         if not claim_image(output_filename):
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
+        if os.path.exists(image_path(output_filename)):
+            # A concurrent create finished between name_taken() and our
+            # marker acquisition; the marker alone isn't the whole claim —
+            # marker + absent PNG is. Never overwrite a finished image.
+            release_claim(output_filename, keep_png=True)
+            return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
         try:
             create_embedding_image(
                 store, parent_filename, label_name, output_filename, images_path, method
@@ -97,7 +103,15 @@ def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
 
     @app.route("/images", methods=("GET",))
     def get_images(request):
-        return {MESSAGE_RESULT: os.listdir(images_path)}, 200
+        # Only finished PNGs — in-flight `.part` claim markers are an
+        # implementation detail the client never sees (the reference
+        # lists only rendered images, tsne_image/server.py:110-118).
+        listing = [
+            name
+            for name in os.listdir(images_path)
+            if not name.endswith(CLAIM_SUFFIX)
+        ]
+        return {MESSAGE_RESULT: listing}, 200
 
     @app.route("/images/<filename>", methods=("GET",))
     def get_image(request, filename):

@@ -1,5 +1,6 @@
 """Core store: Mongo-contract semantics, WAL durability, aggregation."""
 
+import json
 import threading
 
 import pytest
@@ -298,7 +299,10 @@ class TestColumnarBlock:
             "new": "n",
             ROW_ID: 1,
         }
-        assert store.find_one("ds", {ROW_ID: 2})["new"] is None
+        # Row 2 never got the field: Mongo missing-field semantics — the
+        # synthesized document omits it entirely ($exists False).
+        assert "new" not in store.find_one("ds", {ROW_ID: 2})
+        assert store.find_one("ds", {"new": {"$exists": False}, ROW_ID: 2}) is not None
         store.set_field_values("ds", "a", {1: 10, 2: 20})
         assert store.read_columns("ds", ["a"]) == {"a": [10, 20]}
         # metadata (overlay) survives untouched
@@ -340,6 +344,41 @@ class TestColumnarBlock:
         store.insert_columns("ds", {"a": list(range(100))})
         docs = list(store.find("ds", skip=95, limit=10))
         assert [d[ROW_ID] for d in docs] == [96, 97, 98, 99, 100]
+
+    def test_padded_fields_never_leak_missing_sentinel(self, store):
+        # Adding a field to one block row pads the others; the pads must
+        # read as None via every columnar exit, never as the sentinel.
+        store.insert_columns("ds", {"a": [1, 2, 3]})
+        store.update_one("ds", {ROW_ID: 2}, {"new": "n"})
+        cols = store.read_columns("ds", ["new"])
+        assert cols == {"new": [None, "n", None]}
+        assert all(v is None or isinstance(v, str) for v in cols["new"])
+        result = store.aggregate(
+            "ds", [{"$group": {"_id": "$new", "count": {"$sum": 1}}}]
+        )
+        assert {r["_id"]: r["count"] for r in result} == {None: 2, "n": 1}
+        # and the whole payload is JSON-serializable (the wire contract)
+        json.dumps(cols), json.dumps(result)
+
+    def test_compact_serializes_pads_and_survives(self, tmp_path):
+        data_dir = str(tmp_path / "wal")
+        store = InMemoryStore(data_dir=data_dir)
+        store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": True})
+        store.insert_columns("ds", {"a": [1, 2, 3]})
+        store.update_one("ds", {ROW_ID: 2}, {"new": "n"})
+        store.compact()  # must not TypeError on the _Missing pads
+        # writes still work after compaction (WAL handle reopened)
+        store.insert_one("ds", {"a": 4})
+        replayed = InMemoryStore(data_dir=data_dir)
+        # pads survive the snapshot as true missing fields, not nulls
+        assert "new" not in replayed.find_one("ds", {ROW_ID: 1})
+        assert replayed.find_one("ds", {ROW_ID: 2})["new"] == "n"
+        assert (
+            replayed.find_one("ds", {ROW_ID: 3, "new": {"$exists": False}})
+            is not None
+        )
+        assert replayed.find_one("ds", {"a": 4}) is not None
+        assert replayed.metadata("ds")["finished"] is True
 
 
 def test_set_column_block_replace_and_wal(tmp_path):

@@ -255,6 +255,35 @@ class TestImagesService:
         assert response.status_code == 406
         assert body(response) == {"result": "invalid_field"}
 
+    def test_listing_hides_inflight_claim_markers(self, numeric_store, tmp_path):
+        client = images.create_app(numeric_store, str(tmp_path), "pca").test_client()
+        (tmp_path / "pending.png.part").touch()  # simulated in-flight create
+        assert body(client.get("/images"))["result"] == []
+        response = client.get("/images/pending")
+        assert response.status_code == 404
+
+    def test_claim_never_overwrites_finished_png(self, numeric_store, tmp_path):
+        client = images.create_app(numeric_store, str(tmp_path), "pca").test_client()
+        response = client.post(
+            "/images/numbers", json={"pca_filename": "img", "label_name": "Survived"}
+        )
+        assert response.status_code == 201
+        rendered = (tmp_path / "img.png").read_bytes()
+        # Simulate the race: name_taken() saw nothing (a concurrent
+        # winner finished in the window), the marker is acquired, but the
+        # PNG exists — the loser must 409 and leave the image untouched.
+        import unittest.mock
+
+        with unittest.mock.patch.object(images.os, "listdir", return_value=[]):
+            response = client.post(
+                "/images/numbers",
+                json={"pca_filename": "img", "label_name": "Survived"},
+            )
+        assert response.status_code == 409
+        assert body(response) == {"result": "duplicate_file"}
+        assert (tmp_path / "img.png").read_bytes() == rendered
+        assert not (tmp_path / "img.png.part").exists()
+
 
 class TestQueryPassThrough:
     def test_operator_query_over_rest(self, ingested):
