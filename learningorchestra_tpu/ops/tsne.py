@@ -1,11 +1,12 @@
-"""Exact t-SNE as one jitted device program.
+"""t-SNE on device: exact, data-parallel over the mesh, with a landmark
+path for datasets past the O(n²) wall.
 
 Replaces the reference's driver-side ``sklearn.manifold.TSNE()
 .fit_transform`` (reference: microservices/tsne_image/tsne.py:87-88) —
 single-host, O(n²), the headline scalability cliff (SURVEY.md §3.4,
 BASELINE.json north-star metric).
 
-TPU shape: every stage is matmul/elementwise —
+TPU shape — every stage is matmul/elementwise:
 
 - pairwise squared distances via ``‖x‖² + ‖y‖² − 2 X Xᵀ`` (MXU);
 - per-row bandwidth calibration to the target perplexity as a
@@ -14,11 +15,21 @@ TPU shape: every stage is matmul/elementwise —
   inside ``lax.fori_loop`` with momentum + adaptive gains, early
   exaggeration folded in by phase.
 
-Memory is O(n²) on device, like exact t-SNE everywhere; the affinity
-build is chunked over row blocks (``lax.map``) so the transient
-distance tensor stays bounded. Defaults match the reference's sklearn
-0.23: perplexity 30, 1000 iterations, early exaggeration 12 for the
-first 250.
+Parallelism: both the affinity build and the gradient loop run under
+``jax.shard_map`` with rows split over the mesh's ``data`` axis — each
+chip owns an ``(n/D, n)`` slab of P and of the repulsion matrix, the
+single global scalar (the Q normalizer) is a ``psum`` over ICI, and the
+``(n, 2)`` gradient is an ``all_gather`` (tiny) so the embedding state
+stays replicated. Rows are zero-padded to the mesh size with a validity
+mask; padded rows have zero affinity and zero repulsion weight, so they
+never influence real points. Per-chip memory is O(n²/D), the exact
+algorithm's floor.
+
+Past ``EXACT_ROWS_LIMIT`` rows the ``landmark`` method runs exact t-SNE
+on a random subsample and places every remaining row by
+perplexity-calibrated kernel regression onto the landmark embedding —
+an ``(n, m)`` matmul pipeline that is row-sharded and chunked, so 1M+
+rows fit comfortably on one chip and scale linearly with the data axis.
 """
 
 from __future__ import annotations
@@ -29,9 +40,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as PSpec
 
 from learningorchestra_tpu.ml.base import resolve_mesh
+from learningorchestra_tpu.parallel.mesh import DATA_AXIS, data_size
 
 PERPLEXITY = 30.0
 ITERATIONS = 1000
@@ -39,6 +52,10 @@ EARLY_EXAGGERATION = 12.0
 EARLY_PHASE = 250
 LEARNING_RATE = 200.0
 CHUNK = 1024
+# Exact t-SNE holds O(n²/D) per chip; past this the landmark path wins.
+EXACT_ROWS_LIMIT = 20_000
+LANDMARKS = 5_000
+INTERP_CHUNK = 8_192
 
 
 def _squared_distances(A, B):
@@ -49,12 +66,13 @@ def _squared_distances(A, B):
     )
 
 
-def _calibrate_row_block(block_distances, self_mask, perplexity):
+def _calibrate_row_block(block_distances, excluded, perplexity):
     """Per-row Gaussian bandwidths matching ``log(perplexity)`` entropy,
     by bisection on beta = 1/(2σ²). Fully vectorized over the block.
-    ``self_mask`` marks each row's own column — self-affinity is excluded
-    by INDEX, so duplicate rows keep their (maximal) mutual affinity like
-    sklearn's TSNE."""
+    ``excluded`` masks columns that must get zero affinity (each row's
+    own column, padding) — self-affinity is excluded by INDEX, so
+    duplicate rows keep their (maximal) mutual affinity like sklearn's
+    TSNE."""
     target = jnp.log(perplexity)
 
     def entropy_and_p(beta):
@@ -62,7 +80,7 @@ def _calibrate_row_block(block_distances, self_mask, perplexity):
         logits = -block_distances * beta[:, None]
         logits = logits - logits.max(axis=1, keepdims=True)
         p = jnp.exp(logits)
-        p = p * ~self_mask
+        p = p * ~excluded
         total = jnp.maximum(p.sum(axis=1, keepdims=True), 1e-12)
         p = p / total
         entropy = -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0), axis=1)
@@ -90,59 +108,231 @@ def _calibrate_row_block(block_distances, self_mask, perplexity):
     return p
 
 
-@partial(jax.jit, static_argnames=("chunk",))
-def _affinities(X, perplexity, chunk: int):
-    """Symmetrized conditional affinities P, built block-of-rows at a
-    time so the distance transient is (chunk, n), not (n, n) twice."""
-    n = X.shape[0]
-    pad = (-n) % chunk
-    X_padded = jnp.pad(X, ((0, pad), (0, 0)))
-    blocks = X_padded.reshape(-1, chunk, X.shape[1])
-    offsets = jnp.arange(blocks.shape[0]) * chunk
+@partial(jax.jit, static_argnames=("mesh", "chunk"))
+def _affinities(mesh: Mesh, X, valid, perplexity, chunk: int):
+    """Symmetrized conditional affinities P, row-sharded over ``data``.
 
-    def one_block(args):
-        block, offset = args
-        distances = _squared_distances(block, X)
-        rows = offset + jnp.arange(chunk)
-        self_mask = rows[:, None] == jnp.arange(n)[None, :]
-        return _calibrate_row_block(distances, self_mask, perplexity)
+    ``X``/``valid`` are replicated ``(n_pad, …)``; each chip builds its
+    own ``(n_pad/D, n_pad)`` slab, chunked block-of-rows at a time so
+    the distance transient is ``(chunk, n_pad)``, not the full square.
+    Padded rows/columns get exactly zero affinity.
+    """
+    n_pad = X.shape[0]
+    shards = data_size(mesh)
+    local = n_pad // shards
+    pad_local = -(-local // chunk) * chunk
 
-    P = jax.lax.map(one_block, (blocks, offsets)).reshape(-1, n)[:n]
-    P = (P + P.T) / (2.0 * n)
+    def local_slab(X_full, valid_full):
+        row0 = jax.lax.axis_index(DATA_AXIS) * local
+        X_local = jax.lax.dynamic_slice_in_dim(X_full, row0, local, 0)
+        X_local = jnp.pad(X_local, ((0, pad_local - local), (0, 0)))
+        blocks = X_local.reshape(-1, chunk, X_full.shape[1])
+        offsets = row0 + jnp.arange(blocks.shape[0]) * chunk
+
+        def one_block(args):
+            block, offset = args
+            distances = _squared_distances(block, X_full)
+            rows = offset + jnp.arange(chunk)
+            excluded = (rows[:, None] == jnp.arange(n_pad)[None, :]) | (
+                ~valid_full[None, :]
+            )
+            p = _calibrate_row_block(distances, excluded, perplexity)
+            # zero out padded rows (clamped indexing is fine: overhang
+            # rows are sliced off below)
+            return p * valid_full[jnp.minimum(rows, n_pad - 1), None]
+
+        slab = jax.lax.map(one_block, (blocks, offsets))
+        return slab.reshape(pad_local, n_pad)[:local]
+
+    P = jax.shard_map(
+        local_slab,
+        mesh=mesh,
+        in_specs=(PSpec(), PSpec()),
+        out_specs=PSpec(DATA_AXIS),
+        check_vma=False,
+    )(X, valid)
+    n_valid = valid.sum().astype(P.dtype)
+    P = (P + P.T) / (2.0 * n_valid)
     return jnp.maximum(P, 1e-12)
 
 
-@partial(jax.jit, static_argnames=("iterations", "early_phase"))
-def _optimize(P, Y0, iterations: int, early_phase: int, learning_rate, exaggeration):
-    n = Y0.shape[0]
+@partial(jax.jit, static_argnames=("mesh", "iterations", "early_phase"))
+def _optimize(
+    mesh: Mesh, P, Y0, valid, iterations: int, early_phase: int,
+    learning_rate, exaggeration,
+):
+    """Gradient descent with momentum + adaptive gains, sharded like P:
+    each chip computes its row slab of the attraction/repulsion matrix,
+    the Q normalizer is one psum, and the (n, 2) gradient is
+    all_gathered so Y/velocity/gains stay replicated (tiny state)."""
+    n_pad = Y0.shape[0]
+    shards = data_size(mesh)
+    local = n_pad // shards
 
-    def gradient(Y, P_eff):
-        distances = _squared_distances(Y, Y)
-        inv = 1.0 / (1.0 + distances)
-        inv = inv * (1.0 - jnp.eye(n, dtype=Y.dtype))
-        Q = inv / jnp.maximum(inv.sum(), 1e-12)
-        W = (P_eff - jnp.maximum(Q, 1e-12)) * inv
-        return 4.0 * (W.sum(axis=1)[:, None] * Y - W @ Y)
-
-    def step(i, state):
-        Y, velocity, gains = state
-        P_eff = jnp.where(i < early_phase, P * exaggeration, P)
-        grad = gradient(Y, P_eff).astype(Y.dtype)
-        momentum = jnp.where(i < early_phase, 0.5, 0.8).astype(Y.dtype)
-        same_sign = jnp.sign(grad) == jnp.sign(velocity)
-        gains = jnp.maximum(
-            jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01
+    def run(P_local, Y0_full, valid_full):
+        row0 = jax.lax.axis_index(DATA_AXIS) * local
+        valid_local = jax.lax.dynamic_slice_in_dim(valid_full, row0, local, 0)
+        rows = row0 + jnp.arange(local)
+        pair_mask = (
+            valid_local[:, None]
+            & valid_full[None, :]
+            & (rows[:, None] != jnp.arange(n_pad)[None, :])
         )
-        velocity = momentum * velocity - learning_rate * gains * grad
-        return Y + velocity, velocity, gains
 
-    Y, _, _ = jax.lax.fori_loop(
-        0,
-        iterations,
-        step,
-        (Y0, jnp.zeros_like(Y0), jnp.ones_like(Y0)),
+        def gradient(Y, P_eff):
+            Y_local = jax.lax.dynamic_slice_in_dim(Y, row0, local, 0)
+            distances = _squared_distances(Y_local, Y)
+            inv = (1.0 / (1.0 + distances)) * pair_mask
+            total = jax.lax.psum(inv.sum(), DATA_AXIS)
+            Q = inv / jnp.maximum(total, 1e-12)
+            W = (P_eff - jnp.maximum(Q, 1e-12)) * inv
+            grad_local = 4.0 * (W.sum(axis=1)[:, None] * Y_local - W @ Y)
+            return jax.lax.all_gather(
+                grad_local, DATA_AXIS, axis=0, tiled=True
+            )
+
+        def step(i, state):
+            Y, velocity, gains = state
+            P_eff = jnp.where(i < early_phase, P_local * exaggeration, P_local)
+            grad = gradient(Y, P_eff).astype(Y.dtype)
+            momentum = jnp.where(i < early_phase, 0.5, 0.8).astype(Y.dtype)
+            same_sign = jnp.sign(grad) == jnp.sign(velocity)
+            gains = jnp.maximum(
+                jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01
+            )
+            velocity = momentum * velocity - learning_rate * gains * grad
+            return Y + velocity, velocity, gains
+
+        Y, _, _ = jax.lax.fori_loop(
+            0,
+            iterations,
+            step,
+            (Y0_full, jnp.zeros_like(Y0_full), jnp.ones_like(Y0_full)),
+        )
+        return Y
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(PSpec(DATA_AXIS), PSpec(), PSpec()),
+        out_specs=PSpec(),
+        check_vma=False,
+    )(P, Y0, valid)
+
+
+def _pad_for_mesh(X: np.ndarray, mesh: Mesh, chunk: int) -> tuple:
+    """Zero-pad rows to a multiple of the data axis, build the validity
+    mask, and pick the per-chip chunk size."""
+    shards = data_size(mesh)
+    n = len(X)
+    n_pad = -(-n // shards) * shards
+    valid = np.zeros(n_pad, dtype=bool)
+    valid[:n] = True
+    X_pad = np.pad(X, ((0, n_pad - n), (0, 0)))
+    chunk = max(1, min(chunk, n_pad // shards))
+    return X_pad, valid, chunk
+
+
+def _tsne_exact(
+    X: np.ndarray,
+    mesh: Mesh,
+    perplexity: float,
+    iterations: int,
+    learning_rate: float,
+    seed: int,
+) -> np.ndarray:
+    n = len(X)
+    X_pad, valid, chunk = _pad_for_mesh(X, mesh, CHUNK)
+    replicated = NamedSharding(mesh, PSpec())
+    X_dev = jax.device_put(jnp.asarray(X_pad), replicated)
+    valid_dev = jax.device_put(jnp.asarray(valid), replicated)
+    perplexity = min(perplexity, max((n - 1) / 3.0, 1.0))
+    P = _affinities(mesh, X_dev, valid_dev, jnp.float32(perplexity), chunk)
+    Y0 = (
+        jax.random.normal(jax.random.key(seed), (len(X_pad), 2), jnp.float32)
+        * 1e-4
     )
-    return Y
+    Y0 = jax.device_put(Y0, replicated)
+    Y = _optimize(
+        mesh,
+        P,
+        Y0,
+        valid_dev,
+        iterations,
+        min(EARLY_PHASE, iterations // 2),
+        jnp.float32(learning_rate),
+        jnp.float32(EARLY_EXAGGERATION),
+    )
+    return np.asarray(Y)[:n]
+
+
+@partial(jax.jit, static_argnames=("mesh", "chunk"))
+def _interpolate(mesh: Mesh, X, landmarks, Y_landmarks, perplexity, chunk: int):
+    """Out-of-sample placement: perplexity-calibrated Gaussian affinities
+    from each row to the landmark set, then one ``P @ Y_L`` matmul. Rows
+    are sharded over ``data`` and processed in chunks, so the transient
+    is ``(chunk, m)`` per chip — linear scaling in n."""
+    n_pad = X.shape[0]
+    local = n_pad // data_size(mesh)
+
+    def run(X_local, L_full, Y_full):
+        blocks = X_local.reshape(-1, chunk, X_local.shape[1])
+
+        def one_block(block):
+            distances = _squared_distances(block, L_full)
+            excluded = jnp.zeros(distances.shape, bool)
+            p = _calibrate_row_block(distances, excluded, perplexity)
+            return p @ Y_full
+
+        return jax.lax.map(one_block, blocks).reshape(local, 2)
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(PSpec(DATA_AXIS), PSpec(), PSpec()),
+        out_specs=PSpec(DATA_AXIS),
+        check_vma=False,
+    )(X, landmarks, Y_landmarks)
+
+
+def _tsne_landmark(
+    X: np.ndarray,
+    mesh: Mesh,
+    perplexity: float,
+    iterations: int,
+    learning_rate: float,
+    seed: int,
+    landmarks: int,
+) -> np.ndarray:
+    n = len(X)
+    rng = np.random.default_rng(seed)
+    m = min(landmarks, n)
+    chosen = rng.choice(n, size=m, replace=False)
+    L = X[chosen]
+    Y_L = _tsne_exact(L, mesh, perplexity, iterations, learning_rate, seed)
+    if m == n:
+        # Every row IS a landmark: the exact embedding is already the
+        # answer — undo the sampling permutation instead of blurring it
+        # through interpolation.
+        out = np.empty((n, 2), np.float32)
+        out[chosen] = Y_L
+        return out
+
+    shards = data_size(mesh)
+    chunk = min(INTERP_CHUNK, -(-n // shards))
+    multiple = shards * chunk
+    n_pad = -(-n // multiple) * multiple
+    X_pad = np.pad(X, ((0, n_pad - n), (0, 0)))
+    row_sharded = NamedSharding(mesh, PSpec(DATA_AXIS))
+    X_dev = jax.device_put(jnp.asarray(X_pad), row_sharded)
+    replicated = NamedSharding(mesh, PSpec())
+    L_dev = jax.device_put(jnp.asarray(L), replicated)
+    Y_L_dev = jax.device_put(jnp.asarray(Y_L, np.float32), replicated)
+    interp_perplexity = min(perplexity, max((m - 1) / 3.0, 1.0))
+    Y = _interpolate(
+        mesh, X_dev, L_dev, Y_L_dev, jnp.float32(interp_perplexity), chunk
+    )
+    return np.asarray(Y)[:n]
 
 
 def tsne_embedding(
@@ -152,22 +342,25 @@ def tsne_embedding(
     learning_rate: float = LEARNING_RATE,
     seed: int = 0,
     mesh: Optional[Mesh] = None,
+    method: str = "auto",
+    exact_rows_limit: int = EXACT_ROWS_LIMIT,
+    landmarks: int = LANDMARKS,
 ) -> np.ndarray:
-    """2-D t-SNE embedding of ``X``. Returns ``(rows, 2)``."""
-    resolve_mesh(mesh)  # device presence check; single program, no sharding yet
+    """2-D t-SNE embedding of ``X``. Returns ``(rows, 2)``.
+
+    ``method``: ``"exact"`` (O(n²/chip), sharded over the data axis),
+    ``"landmark"`` (exact on a subsample + calibrated kernel regression
+    for the rest — linear in n), or ``"auto"`` (exact up to
+    ``exact_rows_limit`` rows).
+    """
+    mesh = resolve_mesh(mesh)
     X = np.asarray(X, np.float32)
-    n = len(X)
-    perplexity = min(perplexity, max((n - 1) / 3.0, 1.0))
-    P = _affinities(jnp.asarray(X), jnp.float32(perplexity), min(CHUNK, n))
-    Y0 = (
-        jax.random.normal(jax.random.key(seed), (n, 2), jnp.float32) * 1e-4
-    )
-    Y = _optimize(
-        P,
-        Y0,
-        iterations,
-        min(EARLY_PHASE, iterations // 2),
-        jnp.float32(learning_rate),
-        jnp.float32(EARLY_EXAGGERATION),
-    )
-    return np.asarray(Y)
+    if method == "auto":
+        method = "exact" if len(X) <= exact_rows_limit else "landmark"
+    if method == "exact":
+        return _tsne_exact(X, mesh, perplexity, iterations, learning_rate, seed)
+    if method == "landmark":
+        return _tsne_landmark(
+            X, mesh, perplexity, iterations, learning_rate, seed, landmarks
+        )
+    raise ValueError(f"unknown t-SNE method {method!r}")

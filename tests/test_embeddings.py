@@ -82,10 +82,33 @@ class TestReviewRegressions:
         # neighbour (self excluded by index, not by distance == 0).
         import jax.numpy as jnp
 
-        from learningorchestra_tpu.ops.tsne import _affinities
+        from learningorchestra_tpu.ops.tsne import _affinities, _pad_for_mesh
+        from learningorchestra_tpu.parallel.mesh import default_mesh
 
+        mesh = default_mesh()
         base = rng.normal(size=(20, 3)).astype(np.float32)
         X = np.vstack([base, base[:1]])  # row 20 duplicates row 0
-        P = np.asarray(_affinities(jnp.asarray(X), jnp.float32(5.0), 21))
-        assert P[0].argmax() == 20 and P[20].argmax() == 0
-        assert P[0, 20] > 10 * np.median(P[0])
+        X_pad, valid, chunk = _pad_for_mesh(X, mesh, 1024)
+        P = np.asarray(
+            _affinities(
+                mesh, jnp.asarray(X_pad), jnp.asarray(valid),
+                jnp.float32(5.0), chunk,
+            )
+        )
+        assert P[0, :21].argmax() == 20 and P[20, :21].argmax() == 0
+        assert P[0, 20] > 10 * np.median(P[0, :21])
+        # padded rows/columns carry only the numerical floor, no mass
+        assert (P[21:, :] <= 1e-12).all() and (P[:, 21:] <= 1e-12).all()
+
+    def test_landmark_path_separates_blobs(self, rng):
+        from learningorchestra_tpu.ops.tsne import tsne_embedding
+
+        centers = np.array([[12, 0, 0], [0, 12, 0], [0, 0, 12]])
+        labels = rng.integers(0, 3, size=900)
+        X = centers[labels] + rng.normal(size=(900, 3))
+        embedded = tsne_embedding(
+            X, iterations=300, method="landmark", landmarks=200, seed=0
+        )
+        assert embedded.shape == (900, 2)
+        assert np.isfinite(embedded).all()
+        assert _knn_label_agreement(embedded, labels) > 0.85

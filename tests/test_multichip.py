@@ -81,6 +81,58 @@ class TestMeshShapeInvariance:
             accuracies.append(accuracy_score(y, model.predict(X)))
         assert abs(accuracies[0] - accuracies[1]) < 0.02
 
+    def test_tsne_mesh_invariant(self, rng):
+        # The affinity matrix is deterministic and must match across
+        # mesh shapes (per-chip slabs + psum are just a different
+        # reduction order). The optimized coordinates are chaotic —
+        # float reassociation amplifies over iterations — so the
+        # embedding itself is judged on cluster structure, not values.
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.ops.tsne import (
+            _affinities,
+            _pad_for_mesh,
+            tsne_embedding,
+        )
+
+        centers = np.array([[10, 0], [0, 10], [5, -8]])
+        labels = rng.integers(0, 3, size=120)
+        X = (centers[labels] + rng.normal(size=(120, 2))).astype(np.float32)
+        meshes = (
+            make_mesh(data=1, model=1),
+            make_mesh(data=8, model=1),
+            make_mesh(data=4, model=2),
+        )
+        affinity_matrices = []
+        for mesh in meshes:
+            X_pad, valid, chunk = _pad_for_mesh(X, mesh, 1024)
+            P = _affinities(
+                mesh, jnp.asarray(X_pad), jnp.asarray(valid),
+                jnp.float32(10.0), chunk,
+            )
+            affinity_matrices.append(np.asarray(P)[:120, :120])
+        np.testing.assert_allclose(
+            affinity_matrices[0], affinity_matrices[1], atol=1e-7
+        )
+        np.testing.assert_allclose(
+            affinity_matrices[0], affinity_matrices[2], atol=1e-7
+        )
+        for mesh in meshes:
+            embedded = tsne_embedding(X, iterations=250, seed=3, mesh=mesh)
+            d = ((embedded[:, None, :] - embedded[None, :, :]) ** 2).sum(-1)
+            np.fill_diagonal(d, np.inf)
+            assert (labels[d.argmin(axis=1)] == labels).mean() > 0.9
+
+    def test_pca_mesh_invariant(self, rng):
+        from learningorchestra_tpu.ops.pca import pca_embedding
+
+        X = rng.normal(size=(200, 5))
+        results = [
+            pca_embedding(X, mesh=mesh)
+            for mesh in (make_mesh(data=1, model=1), make_mesh(data=8, model=1))
+        ]
+        np.testing.assert_allclose(results[0], results[1], atol=1e-3)
+
 
 class TestDriverDryrun:
     def test_entry_compiles(self):
