@@ -69,10 +69,17 @@ def train_one(
     features_evaluation: Optional[DataFrame],
     prediction_filename: str,
     mesh: Optional[Mesh] = None,
+    write_outputs: bool = True,
 ) -> dict:
     """Fit + evaluate + persist one classifier (the reference's
     ``classificator_handler``, model_builder.py:178-230). Returns the
-    prediction collection's metadata document."""
+    prediction collection's metadata document.
+
+    ``write_outputs=False`` runs the full compute path (fit, evaluate,
+    predict — all of which enter cross-host collectives and must run on
+    every process of a multi-host mesh) but skips the store writes: SPMD
+    worker processes pass False so the shared store sees exactly one
+    writer (parallel/spmd.py)."""
     output_name = f"{prediction_filename}_prediction_{classificator_name}"
     metadata = {
         "filename": output_name,
@@ -113,13 +120,15 @@ def train_one(
     # bulk prediction write is timed as its own phase — it is the
     # reference's wall-clock tail (driver collect() + row-wise inserts,
     # model_builder.py:232-247) and the number the benchmark reports.
-    store.drop(output_name)
-    with timer.phase("write"):
-        insert_columns_batched(
-            store, output_name, _prediction_columns(predicted_df)
-        )
+    if write_outputs:
+        store.drop(output_name)
+        with timer.phase("write"):
+            insert_columns_batched(
+                store, output_name, _prediction_columns(predicted_df)
+            )
     metadata["timings"] = timer.as_metadata()
-    store.insert_one(output_name, metadata)
+    if write_outputs:
+        store.insert_one(output_name, metadata)
     return metadata
 
 
@@ -130,9 +139,12 @@ def build_model(
     preprocessor_code: str,
     classificators_list: list[str],
     mesh: Optional[Mesh] = None,
+    write_outputs: bool = True,
 ) -> list[dict]:
     """The reference's ``build_model`` (model_builder.py:133-176):
     preprocess once, then one thread per classifier."""
+    import jax
+
     unknown = [n for n in classificators_list if n not in CLASSIFIER_NAMES]
     if unknown:
         raise KeyError(f"invalid classificator names {unknown}")
@@ -141,8 +153,16 @@ def build_model(
     testing_df = load_dataframe(store, test_filename)
     out = run_preprocessor(preprocessor_code, training_df, testing_df)
 
+    # Multi-host SPMD: every process must dispatch the classifiers'
+    # device programs in the SAME order, and thread scheduling is not
+    # deterministic across hosts — serialize the fan-out. Single-host
+    # keeps the reference's thread-per-classifier shape
+    # (model_builder.py:159-175).
+    max_workers = (
+        1 if jax.process_count() > 1 else len(classificators_list) or 1
+    )
     results: list[dict] = []
-    with ThreadPoolExecutor(max_workers=len(classificators_list) or 1) as pool:
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
         futures = [
             pool.submit(
                 train_one,
@@ -153,6 +173,7 @@ def build_model(
                 out["features_evaluation"],
                 test_filename,
                 mesh,
+                write_outputs,
             )
             for name in classificators_list
         ]

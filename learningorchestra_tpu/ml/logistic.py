@@ -30,6 +30,7 @@ from learningorchestra_tpu.ml.base import (
     prepare_xy,
     resolve_mesh,
 )
+from learningorchestra_tpu.parallel.multihost import fetch
 
 
 def _loss_fn(params, X, y, mask, l2):
@@ -62,6 +63,25 @@ def _fit(params, X, y, mask, max_iter: int, l2):
 
 
 @jax.jit
+def _masked_stats(X, mask):
+    """Per-feature mean/scale from a row-sharded matrix + validity mask —
+    the standardization step computed ON DEVICE, so a fit can start from
+    per-host-fed shards without any host ever holding the full dataset.
+    The reductions cross the data axis; XLA inserts the psums."""
+    weights = mask.astype(X.dtype)
+    count = weights.sum()
+    mean = (X * weights[:, None]).sum(axis=0) / count
+    var = ((X - mean) ** 2 * weights[:, None]).sum(axis=0) / count
+    std = jnp.sqrt(var)
+    return mean, jnp.where(std > 0, std, 1.0)
+
+
+@jax.jit
+def _standardize(X, mean, scale, weights):
+    return ((X - mean) / scale) * weights[:, None]
+
+
+@jax.jit
 def _forward(params, X, mean, scale):
     logits = ((X - mean) / scale) @ params["w"] + params["b"]
     probs = jax.nn.softmax(logits)
@@ -79,7 +99,7 @@ class LogisticRegressionModel(FittedModel):
         X_dev, _, mask = prepare_xy(X, None, self.mesh)
         labels, probs = _forward(self.params, X_dev, self.mean, self.scale)
         n = len(X)
-        return np.asarray(labels)[:n], np.asarray(probs)[:n]
+        return fetch(labels)[:n], fetch(probs)[:n]
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         return self._eval(X)[0]
@@ -108,11 +128,50 @@ class LogisticRegression:
         scale = np.where(std > 0, std, 1.0)
         X_std = (np.asarray(X) - mean) / scale
         X_dev, y_dev, mask = prepare_xy(X_std, y, self.mesh)
+        return self._fit_prepared(
+            X_dev,
+            y_dev,
+            mask,
+            num_classes,
+            jnp.asarray(mean, jnp.float32),
+            jnp.asarray(scale, jnp.float32),
+        )
+
+    def fit_sharded(
+        self,
+        X_dev: jax.Array,
+        y_dev: jax.Array,
+        mask: jax.Array,
+        num_classes: int,
+    ) -> LogisticRegressionModel:
+        """Fit from already row-sharded device arrays — the per-host
+        feeding entry: pair with ``parallel.shard_rows_local`` so on a
+        multi-host mesh each host loads only its ``host_row_range`` row
+        slice and NO process ever materializes the full dataset (the
+        100M-row ingestion story; reference workers instead each read
+        their Mongo partitions). Standardization happens on device from
+        the shards (:func:`_masked_stats`); ``num_classes`` must be given
+        since no host can scan all labels.
+        """
+        mean, scale = _masked_stats(X_dev, mask)
+        X_std = _standardize(X_dev, mean, scale, mask.astype(X_dev.dtype))
+        return self._fit_prepared(
+            X_std,
+            y_dev,
+            mask,
+            num_classes,
+            mean.astype(jnp.float32),
+            scale.astype(jnp.float32),
+        )
+
+    def _fit_prepared(
+        self, X_dev, y_dev, mask, num_classes, mean, scale
+    ) -> LogisticRegressionModel:
         # Tensor parallelism: the class dimension of W/b is sharded over
         # the mesh's model axis (init sharding propagates through the
         # whole L-BFGS scan), so X @ W partitions its output columns and
         # log_softmax's normalizer is the only model-axis collective.
-        num_features = X_std.shape[1]
+        num_features = X_dev.shape[1]
         # Replicate when classes don't divide the axis (NamedSharding
         # needs even splits); the data axis still carries the rows.
         shardable = num_classes % model_size(self.mesh) == 0
@@ -136,9 +195,4 @@ class LogisticRegression:
             max_iter=self.max_iter,
             l2=jnp.float32(self.reg_param),
         )
-        return LogisticRegressionModel(
-            params,
-            jnp.asarray(mean, jnp.float32),
-            jnp.asarray(scale, jnp.float32),
-            self.mesh,
-        )
+        return LogisticRegressionModel(params, mean, scale, self.mesh)

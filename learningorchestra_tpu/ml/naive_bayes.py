@@ -29,6 +29,7 @@ from learningorchestra_tpu.ml.base import (
     prepare_xy,
     resolve_mesh,
 )
+from learningorchestra_tpu.parallel.multihost import fetch
 
 
 @partial(jax.jit, static_argnames=("num_classes",))
@@ -59,7 +60,7 @@ class NaiveBayesModel(FittedModel):
         X_dev, _, _ = prepare_xy(X, None, self.mesh)
         labels, probs = _forward(self.theta, self.prior, X_dev)
         n = len(X)
-        return np.asarray(labels)[:n], np.asarray(probs)[:n]
+        return fetch(labels)[:n], fetch(probs)[:n]
 
     def predict(self, X: np.ndarray) -> np.ndarray:
         return self._eval(X)[0]

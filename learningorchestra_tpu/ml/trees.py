@@ -45,6 +45,7 @@ from learningorchestra_tpu.ml.base import (
     resolve_mesh,
 )
 from learningorchestra_tpu.ml.binning import MAX_BINS, apply_bins, make_thresholds
+from learningorchestra_tpu.parallel.multihost import fetch
 
 MAX_DEPTH = 5          # MLlib default maxDepth
 NUM_TREES = 20         # MLlib default numTrees (RF)
@@ -266,7 +267,7 @@ class _TreeEnsembleModel(FittedModel):
             self.max_depth,
         )
         n = len(X)
-        probs = np.asarray(probs)[:n]
+        probs = fetch(probs)[:n]
         return np.argmax(probs, axis=1), probs
 
     def predict(self, X: np.ndarray) -> np.ndarray:
@@ -446,7 +447,7 @@ class GBTModel(FittedModel):
             self.max_depth,
         )
         n = len(X)
-        probs = np.asarray(probs)[:n]
+        probs = fetch(probs)[:n]
         return np.argmax(probs, axis=1), probs
 
     def predict(self, X: np.ndarray) -> np.ndarray:

@@ -63,9 +63,15 @@ def create_embedding_image(
     output_filename: str,
     images_path: str,
     method: str,
+    render: bool = True,
 ) -> str:
     """Embed ``parent_filename`` with ``method`` ("pca"/"tsne") and write
-    ``<images_path>/<output_filename>.png``. Returns the image path."""
+    ``<images_path>/<output_filename>.png``. Returns the image path.
+
+    ``render=False`` runs the device embedding (whose collectives every
+    process of a multi-host mesh must enter) but skips the host-side PNG
+    rasterization — SPMD worker processes pass False so only the
+    coordinator touches the images volume (parallel/spmd.py)."""
     if not safe_filename(output_filename):
         raise ValueError(f"unsafe image filename {output_filename!r}")
     embed = EMBEDDINGS[method]
@@ -73,10 +79,11 @@ def create_embedding_image(
     encoded, _ = table.encoded()
     X = encoded.matrix()
     embedded = embed(X)
-    hue = None
-    if label_name is not None:
-        hue = np.asarray(encoded.columns[label_name])
-    os.makedirs(images_path, exist_ok=True)
     image_path = os.path.join(images_path, output_filename + IMAGE_FORMAT)
-    _scatter_png(embedded, hue, image_path)
+    if render:
+        hue = None
+        if label_name is not None:
+            hue = np.asarray(encoded.columns[label_name])
+        os.makedirs(images_path, exist_ok=True)
+        _scatter_png(embedded, hue, image_path)
     return image_path

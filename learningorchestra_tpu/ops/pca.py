@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from learningorchestra_tpu.ml.base import prepare_xy, resolve_mesh
+from learningorchestra_tpu.parallel.multihost import fetch
 
 
 @partial(jax.jit, static_argnames=("n_components",))
@@ -47,4 +48,4 @@ def pca_embedding(
     mesh = resolve_mesh(mesh)
     X_dev, _, mask = prepare_xy(X, None, mesh)
     embedded, _, _ = _pca(X_dev, mask, n_components)
-    return np.asarray(embedded)[: len(X)]
+    return fetch(embedded)[: len(X)]

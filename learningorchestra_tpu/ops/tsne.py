@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as PSpec
 
 from learningorchestra_tpu.ml.base import resolve_mesh
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS, data_size
+from learningorchestra_tpu.parallel.multihost import fetch
 
 PERPLEXITY = 30.0
 ITERATIONS = 1000
@@ -263,7 +264,7 @@ def _tsne_exact(
         jnp.float32(learning_rate),
         jnp.float32(EARLY_EXAGGERATION),
     )
-    return np.asarray(Y)[:n]
+    return fetch(Y)[:n]
 
 
 @partial(jax.jit, static_argnames=("mesh", "chunk"))
@@ -332,7 +333,7 @@ def _tsne_landmark(
     Y = _interpolate(
         mesh, X_dev, L_dev, Y_L_dev, jnp.float32(interp_perplexity), chunk
     )
-    return np.asarray(Y)[:n]
+    return fetch(Y)[:n]
 
 
 def tsne_embedding(

@@ -20,3 +20,9 @@ from learningorchestra_tpu.parallel.sharding import (  # noqa: F401
     row_sharded,
     shard_rows,
 )
+from learningorchestra_tpu.parallel.multihost import (  # noqa: F401
+    fetch,
+    host_row_range,
+    initialize_from_env,
+    shard_rows_local,
+)

@@ -1,0 +1,114 @@
+"""SPMD job dispatch: the coordinator receives a job, every host runs it.
+
+A jitted program over a cross-host mesh must be entered by EVERY process
+(SPMD) — one host cannot run a global-mesh fit alone; its devices would
+wait forever in the first cross-host collective. The reference gets the
+same property from Spark: the driver receives one REST request and the
+cluster scheduler launches the job's stages on every worker
+(reference: docker-compose.yml:123-163 master/worker overlay).
+
+Here the coordinator (process 0) serves REST. Worker processes run
+:meth:`SpmdDispatcher.run_worker_loop`, blocked in a broadcast. Each
+compute job the coordinator accepts is serialized to JSON, broadcast
+through the device runtime (``broadcast_one_to_all`` — a length prefix,
+then the payload bytes), and then executed by all processes at once; the
+collectives inside the job line up because every process enters the same
+handler with the same arguments in the same order (the dispatcher lock
+serializes jobs, and the broadcast itself is the cross-host barrier).
+
+Host-side effects (store writes, PNG rendering) stay coordinator-only:
+handlers receive ``coordinator=`` so workers run the compute path but
+skip the writes — compute is global, the product surface is not.
+
+Single-process runs skip all of this: ``submit`` calls the handler
+directly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+_SHUTDOWN_OP = "__shutdown__"
+
+
+def _broadcast_json(obj: Any = None) -> Any:
+    """Broadcast a JSON-serializable object from process 0 to all.
+
+    Every process must call this at the same point; process 0 passes the
+    object, the rest pass nothing and receive it. Variable length rides
+    a two-phase broadcast: a scalar length, then the padded byte buffer.
+    """
+    from jax.experimental import multihost_utils
+
+    payload = b""
+    if jax.process_index() == 0:
+        payload = json.dumps(obj).encode()
+    length = multihost_utils.broadcast_one_to_all(
+        np.array([len(payload)], np.int32)
+    )
+    n = int(length[0])
+    buf = np.zeros(n, np.uint8)
+    if jax.process_index() == 0:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    return json.loads(bytes(buf).decode())
+
+
+class SpmdDispatcher:
+    """Routes compute jobs to every process in the multi-host runtime."""
+
+    def __init__(self) -> None:
+        self._handlers: dict[str, Callable[[dict], Any]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, op: str, handler: Callable[[dict], Any]) -> None:
+        self._handlers[op] = handler
+
+    def submit(self, op: str, payload: dict) -> Any:
+        """Run ``op`` on all hosts; returns the coordinator's result.
+
+        Only the coordinator calls this (workers sit in
+        :meth:`run_worker_loop`). The lock serializes jobs so the
+        broadcast order — and therefore the collective order inside the
+        handlers — is identical on every process.
+        """
+        handler = self._handlers[op]
+        if jax.process_count() == 1:
+            return handler(payload)
+        with self._lock:
+            _broadcast_json({"op": op, "payload": payload})
+            return handler(payload)
+
+    def run_worker_loop(self) -> None:
+        """Worker-process main loop: execute broadcast jobs until
+        shutdown. A failed job is fatal for the worker: it may have
+        aborted between two collectives, and rejoining the loop with a
+        desynchronized collective stream would hang or corrupt every
+        later job — crashing instead tears down the distributed runtime
+        so the coordinator surfaces an error (the reference's Spark
+        stages likewise fail the job when an executor dies mid-stage).
+        The deployment's restart policy brings the worker back."""
+        while True:
+            job = _broadcast_json()
+            if job["op"] == _SHUTDOWN_OP:
+                return
+            try:
+                self._handlers[job["op"]](job["payload"])
+            except Exception:
+                print(
+                    f"[spmd worker {jax.process_index()}] job "
+                    f"{job['op']!r} failed:\n{traceback.format_exc()}",
+                    flush=True,
+                )
+                raise
+
+    def shutdown_workers(self) -> None:
+        if jax.process_count() > 1 and jax.process_index() == 0:
+            with self._lock:
+                _broadcast_json({"op": _SHUTDOWN_OP})

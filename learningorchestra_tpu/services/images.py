@@ -28,9 +28,28 @@ MESSAGE_DELETED_FILE = "deleted_file"
 CLAIM_SUFFIX = ".part"
 
 
-def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
-    """``method`` is "tsne" or "pca"; the request filename key follows it."""
+def create_app(
+    store: DocumentStore, images_path: str, method: str, create=None
+) -> WebApp:
+    """``method`` is "tsne" or "pca"; the request filename key follows it.
+
+    ``create`` overrides how a validated request becomes a
+    create_embedding_image call — the multi-host runner injects an SPMD
+    dispatch (parallel/spmd.py) so every process enters the embedding;
+    default is the in-process call."""
     app = WebApp(method)
+
+    if create is None:
+
+        def create(parent_filename, label_name, output_filename):
+            create_embedding_image(
+                store,
+                parent_filename,
+                label_name,
+                output_filename,
+                images_path,
+                method,
+            )
     filename_key = f"{method}_filename"
     os.makedirs(images_path, exist_ok=True)
 
@@ -92,9 +111,7 @@ def create_app(store: DocumentStore, images_path: str, method: str) -> WebApp:
             release_claim(output_filename, keep_png=True)
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
         try:
-            create_embedding_image(
-                store, parent_filename, label_name, output_filename, images_path, method
-            )
+            create(parent_filename, label_name, output_filename)
         except BaseException:
             release_claim(output_filename, keep_png=False)
             raise

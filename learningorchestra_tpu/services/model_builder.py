@@ -21,8 +21,28 @@ MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
 
 
-def create_app(store: DocumentStore, mesh: Optional[Mesh] = None) -> WebApp:
+def create_app(
+    store: DocumentStore,
+    mesh: Optional[Mesh] = None,
+    build=None,
+) -> WebApp:
+    """``build`` overrides how a validated request body becomes a
+    build_model call — the multi-host runner injects an SPMD dispatch
+    (parallel/spmd.py) so every process enters the fit; default is the
+    in-process call."""
     app = WebApp("model_builder")
+
+    if build is None:
+
+        def build(body: dict) -> None:
+            build_model(
+                store,
+                body["training_filename"],
+                body["test_filename"],
+                body["preprocessor_code"],
+                body["classificators_list"],
+                mesh=mesh,
+            )
 
     @app.route("/models", methods=("POST",))
     def create_model(request):
@@ -48,14 +68,7 @@ def create_app(store: DocumentStore, mesh: Optional[Mesh] = None) -> WebApp:
                 return {
                     MESSAGE_RESULT: validators.MESSAGE_INVALID_CLASSIFICATOR
                 }, 406
-        build_model(
-            store,
-            body["training_filename"],
-            body["test_filename"],
-            body["preprocessor_code"],
-            body["classificators_list"],
-            mesh=mesh,
-        )
+        build(body)
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     return app

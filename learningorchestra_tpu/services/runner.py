@@ -68,25 +68,95 @@ SERVICES: dict[str, int] = {
 }
 
 
-def build_app(name: str, store: DocumentStore, images_dir: str):
+def make_dispatcher(store: DocumentStore, images_dir: str):
+    """SPMD dispatcher for the compute jobs (model fits, embeddings):
+    the coordinator's REST handler submits, every process executes, only
+    the coordinator writes to the store / images volume."""
+    import jax
+
+    from learningorchestra_tpu.ml.builder import build_model
+    from learningorchestra_tpu.ops.images import create_embedding_image
+    from learningorchestra_tpu.parallel.spmd import SpmdDispatcher
+
+    coordinator = jax.process_index() == 0
+    dispatcher = SpmdDispatcher()
+
+    def handle_build_model(payload: dict) -> None:
+        build_model(
+            store,
+            payload["training_filename"],
+            payload["test_filename"],
+            payload["preprocessor_code"],
+            payload["classificators_list"],
+            write_outputs=coordinator,
+        )
+
+    def handle_embedding_image(payload: dict) -> None:
+        create_embedding_image(
+            store,
+            payload["parent_filename"],
+            payload["label_name"],
+            payload["output_filename"],
+            os.path.join(images_dir, payload["method"]),
+            payload["method"],
+            render=coordinator,
+        )
+
+    dispatcher.register("build_model", handle_build_model)
+    dispatcher.register("embedding_image", handle_embedding_image)
+    return dispatcher
+
+
+def build_app(name: str, store: DocumentStore, images_dir: str, dispatcher=None):
     if name == "database_api":
         return database_api.create_app(store, JobManager())
     if name == "projection":
         return projection.create_app(store)
     if name == "model_builder":
-        return model_builder.create_app(store)
+        build = None
+        if dispatcher is not None:
+            def build(body: dict) -> None:
+                dispatcher.submit(
+                    "build_model",
+                    {
+                        key: body[key]
+                        for key in (
+                            "training_filename",
+                            "test_filename",
+                            "preprocessor_code",
+                            "classificators_list",
+                        )
+                    },
+                )
+        return model_builder.create_app(store, build=build)
     if name == "data_type_handler":
         return data_type_handler.create_app(store)
     if name == "histogram":
         return histogram.create_app(store)
     if name in ("tsne", "pca"):
-        return images.create_app(store, os.path.join(images_dir, name), name)
+        create = None
+        if dispatcher is not None:
+            def create(parent_filename, label_name, output_filename):
+                dispatcher.submit(
+                    "embedding_image",
+                    {
+                        "parent_filename": parent_filename,
+                        "label_name": label_name,
+                        "output_filename": output_filename,
+                        "method": name,
+                    },
+                )
+        return images.create_app(
+            store, os.path.join(images_dir, name), name, create=create
+        )
     raise KeyError(f"unknown service {name!r}")
 
 
-def build_apps(store: DocumentStore, images_dir: str) -> dict[int, object]:
+def build_apps(
+    store: DocumentStore, images_dir: str, dispatcher=None
+) -> dict[int, object]:
     return {
-        port: build_app(name, store, images_dir)
+        port: build_app(name, store, images_dir, dispatcher)
         for name, port in SERVICES.items()
     }
 
@@ -96,6 +166,7 @@ def start_all(
     images_dir: Optional[str] = None,
     host: str = "127.0.0.1",
     ephemeral: bool = False,
+    dispatcher=None,
 ) -> tuple[DocumentStore, list[ServerThread]]:
     """Start all seven services on their reference ports; returns the
     shared store and the server threads (callers stop() them).
@@ -107,7 +178,7 @@ def start_all(
     store = store if store is not None else InMemoryStore()
     images_dir = images_dir or os.path.join(os.getcwd(), "lo_images")
     servers = []
-    for port, app in build_apps(store, images_dir).items():
+    for port, app in build_apps(store, images_dir, dispatcher).items():
         server = ServerThread(app, host, 0 if ephemeral else port)
         server.canonical_port = port
         servers.append(server.start())
@@ -116,6 +187,16 @@ def start_all(
 
 def main() -> None:
     from learningorchestra_tpu.core.store_service import connect
+    from learningorchestra_tpu.parallel.multihost import initialize_from_env
+
+    # Join the multi-host device runtime first if the deployment asks for
+    # one (LO_COORDINATOR/LO_NUM_PROCESSES/LO_PROCESS_ID): the compute
+    # services then see the global mesh — the reference's "add spark
+    # workers" knob (README.md:94) as an environment setting. One jax
+    # process per host: run the all-in-one runner (or one compute
+    # service) per host, not seven LO_SERVICE processes each trying to
+    # join as the same process_id.
+    multi_host = initialize_from_env()
 
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     images_dir = os.environ.get(
@@ -130,14 +211,44 @@ def main() -> None:
     else:
         store = InMemoryStore(data_dir=data_dir)
 
+    dispatcher = None
+    if multi_host:
+        import jax
+
+        if not store_url:
+            # Every process of the mesh must see the SAME datasets; a
+            # per-process InMemoryStore would leave workers reading an
+            # empty store and the coordinator waiting forever in its
+            # first cross-host collective. Refuse to start.
+            raise SystemExit(
+                "multi-host mode requires LO_STORE_URL: all processes "
+                "must share one store server "
+                "(python -m learningorchestra_tpu.core.store_service)"
+            )
+        print(
+            f"multi-host runtime: process {jax.process_index()}/"
+            f"{jax.process_count()}, {jax.device_count()} global devices",
+            flush=True,
+        )
+        dispatcher = make_dispatcher(store, images_dir)
+        if jax.process_index() > 0:
+            # Worker host: no REST surface — execute the jobs the
+            # coordinator broadcasts (the spark-worker role,
+            # reference docker-compose.yml:123-163).
+            print("spmd worker: waiting for jobs", flush=True)
+            dispatcher.run_worker_loop()
+            return
+
     if service:
         port = int(os.environ.get("LO_PORT", SERVICES[service]))
-        server = ServerThread(build_app(service, store, images_dir), host, port)
+        server = ServerThread(
+            build_app(service, store, images_dir, dispatcher), host, port
+        )
         server.start()
         print(f"service {service} on {host}:{server.port}", flush=True)
         servers = [server]
     else:
-        _, servers = start_all(store, images_dir, host)
+        _, servers = start_all(store, images_dir, host, dispatcher=dispatcher)
         print(
             f"learningorchestra_tpu serving on ports 5000-5006 (host {host}); "
             f"data in {data_dir}",

@@ -1,0 +1,12 @@
+"""Deterministic synthetic classification dataset shared by the
+multi-host worker and the in-process reference run (not a pytest file)."""
+
+import numpy as np
+
+
+def make_dataset(n: int = 400, features: int = 12, classes: int = 3):
+    rng = np.random.RandomState(7)
+    centers = rng.randn(classes, features) * 3.0
+    y = rng.randint(0, classes, size=n)
+    X = centers[y] + rng.randn(n, features)
+    return X.astype(np.float64), y.astype(np.int64)
