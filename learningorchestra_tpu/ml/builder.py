@@ -16,6 +16,7 @@ are written back in batched columnar writes, not 1 RPC per row.
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Optional
 
@@ -70,6 +71,7 @@ def train_one(
     prediction_filename: str,
     mesh: Optional[Mesh] = None,
     write_outputs: bool = True,
+    models_dir: Optional[str] = None,
 ) -> dict:
     """Fit + evaluate + persist one classifier (the reference's
     ``classificator_handler``, model_builder.py:178-230). Returns the
@@ -79,7 +81,14 @@ def train_one(
     predict — all of which enter cross-host collectives and must run on
     every process of a multi-host mesh) but skips the store writes: SPMD
     worker processes pass False so the shared store sees exactly one
-    writer (parallel/spmd.py)."""
+    writer (parallel/spmd.py).
+
+    ``models_dir`` (or ``LO_MODELS_DIR``) persists the fitted model as a
+    checkpoint named after the prediction collection, recorded in the
+    metadata as ``model_checkpoint`` — the durability the reference
+    lacks (its models die with the request, model_builder.py:232-247;
+    SURVEY.md §5 flags this); :func:`predict_with_model` serves
+    predictions from the artifact without refitting."""
     output_name = f"{prediction_filename}_prediction_{classificator_name}"
     metadata = {
         "filename": output_name,
@@ -96,6 +105,19 @@ def train_one(
         model = classifier.fit(X_train, y_train)
     metadata["fit_time"] = timer.timings["fit"]
 
+    models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
+    if models_dir and write_outputs:
+        from learningorchestra_tpu.ml.checkpoint import (
+            checkpoint_path,
+            save_model,
+        )
+
+        os.makedirs(models_dir, exist_ok=True)
+        artifact = checkpoint_path(models_dir, output_name)
+        with timer.phase("checkpoint"):
+            save_model(model, artifact)
+        metadata["model_checkpoint"] = artifact
+
     if features_evaluation is not None:
         X_eval = features_evaluation.feature_matrix(FEATURES_COL)
         y_eval = features_evaluation.label_vector(LABEL_COL)
@@ -106,6 +128,37 @@ def train_one(
             metadata["F1"] = str(f1_score(y_eval, eval_pred))
             metadata["accuracy"] = str(accuracy_score(y_eval, eval_pred))
 
+    return _predict_and_write(
+        store,
+        model,
+        features_testing,
+        output_name,
+        metadata,
+        timer,
+        write_outputs,
+    )
+
+
+def _predict_and_write(
+    store: DocumentStore,
+    model,
+    features_testing: DataFrame,
+    output_name: str,
+    metadata: dict,
+    timer: PhaseTimer,
+    write_outputs: bool,
+) -> dict:
+    """Predict over the test frame and persist the prediction
+    collection + its metadata document — the shared tail of
+    :func:`train_one` and :func:`predict_with_model`.
+
+    Written directly (not via write_documents): prediction metadata has
+    no ``finished`` flag in the reference either (model_builder.py:
+    191-196; document shape shown in docs/database_api.md:76-83). The
+    bulk prediction write is timed as its own phase — it is the
+    reference's wall-clock tail (driver collect() + row-wise inserts,
+    model_builder.py:232-247) and the number the benchmark reports.
+    """
     X_test = features_testing.feature_matrix(FEATURES_COL)
     with timer.phase("predict"):
         prediction = model.predict(X_test)
@@ -114,12 +167,6 @@ def train_one(
         "prediction", prediction.astype(np.float64)
     ).withColumn("probability", probability)
 
-    # Written directly (not via write_documents): prediction metadata has
-    # no ``finished`` flag in the reference either (model_builder.py:
-    # 191-196; document shape shown in docs/database_api.md:76-83). The
-    # bulk prediction write is timed as its own phase — it is the
-    # reference's wall-clock tail (driver collect() + row-wise inserts,
-    # model_builder.py:232-247) and the number the benchmark reports.
     if write_outputs:
         store.drop(output_name)
         with timer.phase("write"):
@@ -140,6 +187,7 @@ def build_model(
     classificators_list: list[str],
     mesh: Optional[Mesh] = None,
     write_outputs: bool = True,
+    models_dir: Optional[str] = None,
 ) -> list[dict]:
     """The reference's ``build_model`` (model_builder.py:133-176):
     preprocess once, then one thread per classifier."""
@@ -174,6 +222,7 @@ def build_model(
                 test_filename,
                 mesh,
                 write_outputs,
+                models_dir,
             )
             for name in classificators_list
         ]
@@ -181,3 +230,41 @@ def build_model(
     for future in futures:
         results.append(future.result())
     return results
+
+
+def predict_with_model(
+    store: DocumentStore,
+    checkpoint_path: str,
+    test_filename: str,
+    preprocessor_code: str,
+    prediction_filename: str,
+    mesh: Optional[Mesh] = None,
+    write_outputs: bool = True,
+) -> dict:
+    """Serve predictions from a saved checkpoint — no refit.
+
+    Loads the artifact :func:`train_one` persisted, runs the same
+    preprocessor over the test dataset, predicts, and writes the
+    prediction collection in the same shape build_model produces. This
+    is the resume path the reference cannot offer: its fitted models
+    die with the request (model_builder.py:232-247)."""
+    from learningorchestra_tpu.ml.checkpoint import load_model
+
+    model = load_model(checkpoint_path, mesh=mesh)
+    testing_df = load_dataframe(store, test_filename)
+    out = run_preprocessor(preprocessor_code, testing_df, testing_df)
+
+    metadata = {
+        "filename": prediction_filename,
+        "model_checkpoint": checkpoint_path,
+        ROW_ID: 0,
+    }
+    return _predict_and_write(
+        store,
+        model,
+        out["features_testing"],
+        prediction_filename,
+        metadata,
+        PhaseTimer(),
+        write_outputs,
+    )

@@ -12,6 +12,7 @@ training, CPU serving included.
 from __future__ import annotations
 
 import json
+import os
 import zipfile
 from typing import Optional
 
@@ -24,6 +25,14 @@ from learningorchestra_tpu.ml.naive_bayes import NaiveBayesModel
 from learningorchestra_tpu.ml.trees import GBTModel, _TreeEnsembleModel
 
 _HEADER = "__model__.json"
+
+# One artifact naming scheme shared by the builder (which writes) and
+# the model_builder service (which lists/loads): <models_dir>/<name>.model
+CHECKPOINT_SUFFIX = ".model"
+
+
+def checkpoint_path(models_dir: str, name: str) -> str:
+    return os.path.join(models_dir, name + CHECKPOINT_SUFFIX)
 
 
 def _arrays_of(model) -> tuple[str, dict[str, np.ndarray], dict]:
@@ -72,15 +81,21 @@ def _arrays_of(model) -> tuple[str, dict[str, np.ndarray], dict]:
 
 
 def save_model(model, path: str) -> None:
-    """Write a fitted model to ``path`` (.npz format, any extension)."""
+    """Write a fitted model to ``path`` (.npz format, any extension).
+
+    The write is atomic (temp file + ``os.replace``): a concurrent
+    reader never sees a partial archive, and a crash mid-save never
+    leaves a corrupt artifact at the published path."""
     kind, arrays, scalars = _arrays_of(model)
+    tmp_path = path + ".tmp"
     # Write through a file object: np.savez given a *name* appends
     # ".npz", which would split the archive from the header below.
-    with open(path, "wb") as handle:
+    with open(tmp_path, "wb") as handle:
         np.savez(handle, **arrays)
     header = json.dumps({"kind": kind, "scalars": scalars})
-    with zipfile.ZipFile(path, "a") as archive:
+    with zipfile.ZipFile(tmp_path, "a") as archive:
         archive.writestr(_HEADER, header)
+    os.replace(tmp_path, path)
 
 
 def load_model(path: str, mesh: Optional[Mesh] = None):

@@ -3,17 +3,31 @@
 Reference: microservices/model_builder_image/server.py:52-115. The
 request is synchronous: 201 only after ALL classifiers finish
 (server.py:112-115 — SURVEY.md §3.2 notes this is the one synchronous
-job in the reference)."""
+job in the reference).
+
+Beyond reference parity, fitted models persist as checkpoints
+(``LO_MODELS_DIR``/``models_dir``) and are served back over REST:
+``GET /models`` lists artifacts, ``GET /models/<name>`` describes one,
+``POST /models/<name>/predictions`` predicts from the artifact without
+refitting — the durability the reference lacks (its fitted models die
+with the request, model_builder.py:232-247; SURVEY.md §5)."""
 
 from __future__ import annotations
 
+import json
+import os
+import zipfile
 from typing import Optional
 
 from jax.sharding import Mesh
 
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES
-from learningorchestra_tpu.ml.builder import build_model
+from learningorchestra_tpu.ml.builder import build_model, predict_with_model
+from learningorchestra_tpu.ml.checkpoint import (
+    CHECKPOINT_SUFFIX,
+    checkpoint_path as _checkpoint_path,
+)
 from learningorchestra_tpu.services import validators
 from learningorchestra_tpu.utils.web import WebApp
 
@@ -25,12 +39,19 @@ def create_app(
     store: DocumentStore,
     mesh: Optional[Mesh] = None,
     build=None,
+    models_dir: Optional[str] = None,
+    predict=None,
 ) -> WebApp:
-    """``build`` overrides how a validated request body becomes a
-    build_model call — the multi-host runner injects an SPMD dispatch
-    (parallel/spmd.py) so every process enters the fit; default is the
-    in-process call."""
+    """``build``/``predict`` override how a validated request body
+    becomes a build_model / predict_with_model call — the multi-host
+    runner injects an SPMD dispatch (parallel/spmd.py) so every process
+    enters the fit; default is the in-process call. ``models_dir``
+    (default ``LO_MODELS_DIR``) is where checkpoints live."""
     app = WebApp("model_builder")
+    models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
+
+    def checkpoint_path(name: str) -> str:
+        return _checkpoint_path(models_dir, name)
 
     if build is None:
 
@@ -41,6 +62,19 @@ def create_app(
                 body["test_filename"],
                 body["preprocessor_code"],
                 body["classificators_list"],
+                mesh=mesh,
+                models_dir=models_dir,
+            )
+
+    if predict is None:
+
+        def predict(model_name: str, body: dict) -> None:
+            predict_with_model(
+                store,
+                checkpoint_path(model_name),
+                body["test_filename"],
+                body["preprocessor_code"],
+                body["prediction_filename"],
                 mesh=mesh,
             )
 
@@ -69,6 +103,62 @@ def create_app(
                     MESSAGE_RESULT: validators.MESSAGE_INVALID_CLASSIFICATOR
                 }, 406
         build(body)
+        return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    @app.route("/models", methods=("GET",))
+    def list_models(request):
+        if not models_dir or not os.path.isdir(models_dir):
+            return {MESSAGE_RESULT: []}, 200
+        names = sorted(
+            name[: -len(CHECKPOINT_SUFFIX)]
+            for name in os.listdir(models_dir)
+            if name.endswith(CHECKPOINT_SUFFIX)
+        )
+        return {MESSAGE_RESULT: names}, 200
+
+    @app.route("/models/<model_name>", methods=("GET",))
+    def get_model(request, model_name):
+        if (
+            not models_dir
+            or not validators.safe_filename(model_name)
+            or not os.path.isfile(checkpoint_path(model_name))
+        ):
+            return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
+        path = checkpoint_path(model_name)
+        with zipfile.ZipFile(path) as archive:
+            header = json.loads(archive.read("__model__.json"))
+        return {
+            MESSAGE_RESULT: {
+                "name": model_name,
+                "kind": header["kind"],
+                "size_bytes": os.path.getsize(path),
+            }
+        }, 200
+
+    @app.route("/models/<model_name>/predictions", methods=("POST",))
+    def predict_model(request, model_name):
+        body = request.get_json()
+        if (
+            not models_dir
+            or not validators.safe_filename(model_name)
+            or not os.path.isfile(checkpoint_path(model_name))
+        ):
+            return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
+        try:
+            validators.filename_exists(
+                store,
+                body["test_filename"],
+                validators.MESSAGE_INVALID_TEST_FILENAME,
+            )
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        if not validators.safe_filename(body["prediction_filename"]):
+            return {MESSAGE_RESULT: validators.MESSAGE_INVALID_FILENAME}, 406
+        try:
+            validators.filename_free(store, body["prediction_filename"])
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 409
+        predict(model_name, body)
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     return app

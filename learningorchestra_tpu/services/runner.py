@@ -23,6 +23,14 @@ Environment:
 - ``LO_DATA_DIR`` — store WAL directory for the in-process store
   (default ``./lo_data``)
 - ``LO_IMAGES_DIR`` — PNG volume root (default ``<data>/images``)
+- ``LO_MODELS_DIR`` — model checkpoint volume (default
+  ``<data>/models``; empty string disables checkpointing). In
+  multi-host mode this must be a volume shared by every host.
+- ``LO_COORDINATOR`` / ``LO_NUM_PROCESSES`` / ``LO_PROCESS_ID`` —
+  join a multi-host device runtime (parallel/multihost.py): process 0
+  serves REST and broadcasts compute jobs, the rest run SPMD worker
+  loops (parallel/spmd.py). Requires ``LO_STORE_URL`` and a shared
+  ``LO_MODELS_DIR``. One jax process per host.
 - ``LO_HOST`` — bind host. Defaults to ``127.0.0.1``: the model-builder
   service executes request-supplied preprocessor code (reference parity),
   so exposing the stack beyond localhost must be an explicit opt-in
@@ -54,6 +62,7 @@ from learningorchestra_tpu.services import (
     model_builder,
     projection,
 )
+from learningorchestra_tpu.ml.checkpoint import checkpoint_path as _ckpt
 from learningorchestra_tpu.utils.web import ServerThread
 
 
@@ -74,7 +83,7 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
     the coordinator writes to the store / images volume."""
     import jax
 
-    from learningorchestra_tpu.ml.builder import build_model
+    from learningorchestra_tpu.ml.builder import build_model, predict_with_model
     from learningorchestra_tpu.ops.images import create_embedding_image
     from learningorchestra_tpu.parallel.spmd import SpmdDispatcher
 
@@ -88,6 +97,17 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
             payload["test_filename"],
             payload["preprocessor_code"],
             payload["classificators_list"],
+            write_outputs=coordinator,
+            models_dir=payload.get("models_dir") if coordinator else None,
+        )
+
+    def handle_predict_model(payload: dict) -> None:
+        predict_with_model(
+            store,
+            payload["checkpoint_path"],
+            payload["test_filename"],
+            payload["preprocessor_code"],
+            payload["prediction_filename"],
             write_outputs=coordinator,
         )
 
@@ -103,32 +123,55 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
         )
 
     dispatcher.register("build_model", handle_build_model)
+    dispatcher.register("predict_model", handle_predict_model)
     dispatcher.register("embedding_image", handle_embedding_image)
     return dispatcher
 
 
-def build_app(name: str, store: DocumentStore, images_dir: str, dispatcher=None):
+def build_app(
+    name: str,
+    store: DocumentStore,
+    images_dir: str,
+    dispatcher=None,
+    models_dir: str = "",
+):
     if name == "database_api":
         return database_api.create_app(store, JobManager())
     if name == "projection":
         return projection.create_app(store)
     if name == "model_builder":
+        # Opt-in (LO_MODELS_DIR / models_dir): library and test callers
+        # of start_all don't silently grow a checkpoint directory.
+        models_dir = models_dir or os.environ.get("LO_MODELS_DIR", "")
         build = None
+        predict = None
         if dispatcher is not None:
             def build(body: dict) -> None:
+                payload = {
+                    key: body[key]
+                    for key in (
+                        "training_filename",
+                        "test_filename",
+                        "preprocessor_code",
+                        "classificators_list",
+                    )
+                }
+                payload["models_dir"] = models_dir
+                dispatcher.submit("build_model", payload)
+
+            def predict(model_name: str, body: dict) -> None:
                 dispatcher.submit(
-                    "build_model",
+                    "predict_model",
                     {
-                        key: body[key]
-                        for key in (
-                            "training_filename",
-                            "test_filename",
-                            "preprocessor_code",
-                            "classificators_list",
-                        )
+                        "checkpoint_path": _ckpt(models_dir, model_name),
+                        "test_filename": body["test_filename"],
+                        "preprocessor_code": body["preprocessor_code"],
+                        "prediction_filename": body["prediction_filename"],
                     },
                 )
-        return model_builder.create_app(store, build=build)
+        return model_builder.create_app(
+            store, build=build, models_dir=models_dir, predict=predict
+        )
     if name == "data_type_handler":
         return data_type_handler.create_app(store)
     if name == "histogram":
@@ -153,10 +196,10 @@ def build_app(name: str, store: DocumentStore, images_dir: str, dispatcher=None)
 
 
 def build_apps(
-    store: DocumentStore, images_dir: str, dispatcher=None
+    store: DocumentStore, images_dir: str, dispatcher=None, models_dir: str = ""
 ) -> dict[int, object]:
     return {
-        port: build_app(name, store, images_dir, dispatcher)
+        port: build_app(name, store, images_dir, dispatcher, models_dir)
         for name, port in SERVICES.items()
     }
 
@@ -167,6 +210,7 @@ def start_all(
     host: str = "127.0.0.1",
     ephemeral: bool = False,
     dispatcher=None,
+    models_dir: str = "",
 ) -> tuple[DocumentStore, list[ServerThread]]:
     """Start all seven services on their reference ports; returns the
     shared store and the server threads (callers stop() them).
@@ -178,7 +222,7 @@ def start_all(
     store = store if store is not None else InMemoryStore()
     images_dir = images_dir or os.path.join(os.getcwd(), "lo_images")
     servers = []
-    for port, app in build_apps(store, images_dir, dispatcher).items():
+    for port, app in build_apps(store, images_dir, dispatcher, models_dir).items():
         server = ServerThread(app, host, 0 if ephemeral else port)
         server.canonical_port = port
         servers.append(server.start())
@@ -201,6 +245,9 @@ def main() -> None:
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     images_dir = os.environ.get(
         "LO_IMAGES_DIR", os.path.join(data_dir, "images")
+    )
+    models_dir = os.environ.get(
+        "LO_MODELS_DIR", os.path.join(data_dir, "models")
     )
     host = os.environ.get("LO_HOST", "127.0.0.1")
     store_url = os.environ.get("LO_STORE_URL")
@@ -225,6 +272,16 @@ def main() -> None:
                 "must share one store server "
                 "(python -m learningorchestra_tpu.core.store_service)"
             )
+        if os.environ.get("LO_MODELS_DIR") is None:
+            # Same reasoning for checkpoints: predict-from-checkpoint
+            # broadcasts the artifact path to every process, so the
+            # models dir must be a volume all hosts mount — not each
+            # host's local disk. Make the choice explicit.
+            raise SystemExit(
+                "multi-host mode requires LO_MODELS_DIR pointing at a "
+                "volume shared by all hosts (set it to '' to disable "
+                "checkpointing)"
+            )
         print(
             f"multi-host runtime: process {jax.process_index()}/"
             f"{jax.process_count()}, {jax.device_count()} global devices",
@@ -242,13 +299,17 @@ def main() -> None:
     if service:
         port = int(os.environ.get("LO_PORT", SERVICES[service]))
         server = ServerThread(
-            build_app(service, store, images_dir, dispatcher), host, port
+            build_app(service, store, images_dir, dispatcher, models_dir),
+            host,
+            port,
         )
         server.start()
         print(f"service {service} on {host}:{server.port}", flush=True)
         servers = [server]
     else:
-        _, servers = start_all(store, images_dir, host, dispatcher=dispatcher)
+        _, servers = start_all(
+            store, images_dir, host, dispatcher=dispatcher, models_dir=models_dir
+        )
         print(
             f"learningorchestra_tpu serving on ports 5000-5006 (host {host}); "
             f"data in {data_dir}",

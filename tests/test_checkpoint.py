@@ -1,5 +1,7 @@
 """Checkpoint/resume: every fitted model round-trips through disk."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -34,6 +36,109 @@ class TestCheckpoint:
     def test_unknown_type_raises(self, tmp_path):
         with pytest.raises(TypeError):
             save_model(object(), str(tmp_path / "x.npz"))
+
+
+class TestCheckpointWiring:
+    """Checkpoints on the PRODUCT path: build_model persists every
+    fitted model, and a fresh service instance (the 'killed and
+    restarted' process) reproduces predictions from the artifact alone —
+    the durability the reference lacks (model_builder.py:232-247)."""
+
+    def _ingest(self, store, titanic_csv):
+        from learningorchestra_tpu.core.ingest import (
+            ingest_csv,
+            write_ingest_metadata,
+        )
+        from learningorchestra_tpu.ops.dtype import convert_field_types
+
+        for name in ("ck_train", "ck_test"):
+            write_ingest_metadata(store, name, titanic_csv)
+            ingest_csv(store, name, titanic_csv)
+            convert_field_types(
+                store,
+                name,
+                {
+                    f: "number"
+                    for f in (
+                        "PassengerId", "Survived", "Pclass", "Age",
+                        "SibSp", "Parch", "Fare",
+                    )
+                },
+            )
+
+    def test_kill_and_reload_reproduces_predictions(
+        self, store, titanic_csv, tmp_path
+    ):
+        from learningorchestra_tpu.services import model_builder
+        from tests.test_frame import DOCUMENTED_PREPROCESSOR
+
+        self._ingest(store, titanic_csv)
+        models_dir = str(tmp_path / "models")
+
+        app = model_builder.create_app(store, models_dir=models_dir)
+        client = app.test_client()
+        response = client.post(
+            "/models",
+            json={
+                "training_filename": "ck_train",
+                "test_filename": "ck_test",
+                "preprocessor_code": DOCUMENTED_PREPROCESSOR,
+                "classificators_list": ["lr"],
+            },
+        )
+        assert response.status_code == 201
+
+        name = "ck_test_prediction_lr"
+        metadata = store.find_one(name, {"classificator": "lr"})
+        assert metadata["model_checkpoint"] == os.path.join(
+            models_dir, name + ".model"
+        )
+        assert os.path.isfile(metadata["model_checkpoint"])
+        assert "checkpoint" in metadata["timings"]
+        original = store.read_columns(name, ["prediction"])["prediction"]
+
+        # The restarted process: a brand-new app over the same volume.
+        reloaded = model_builder.create_app(
+            store, models_dir=models_dir
+        ).test_client()
+        listing = reloaded.get("/models").get_json()["result"]
+        assert name in listing
+        info = reloaded.get(f"/models/{name}").get_json()["result"]
+        assert info["kind"] == "logistic" and info["size_bytes"] > 0
+
+        response = reloaded.post(
+            f"/models/{name}/predictions",
+            json={
+                "test_filename": "ck_test",
+                "preprocessor_code": DOCUMENTED_PREPROCESSOR,
+                "prediction_filename": "ck_reloaded",
+            },
+        )
+        assert response.status_code == 201
+        reproduced = store.read_columns("ck_reloaded", ["prediction"])[
+            "prediction"
+        ]
+        assert reproduced == original
+        metadata = store.find_one("ck_reloaded", {"_id": 0})
+        assert "fit" not in metadata["timings"]  # no refit happened
+
+    def test_predict_missing_model_404(self, store, tmp_path):
+        from learningorchestra_tpu.services import model_builder
+
+        client = model_builder.create_app(
+            store, models_dir=str(tmp_path)
+        ).test_client()
+        response = client.post(
+            "/models/nope/predictions",
+            json={
+                "test_filename": "x",
+                "preprocessor_code": "",
+                "prediction_filename": "y",
+            },
+        )
+        assert response.status_code == 404
+        assert client.get("/models/nope").status_code == 404
+        assert client.get("/models").get_json()["result"] == []
 
 
 class TestPhaseTimer:
