@@ -1,0 +1,318 @@
+#!/usr/bin/env python3
+"""Supervise the microservice topology: store server + seven services.
+
+The reference deploys this shape as a Docker-swarm stack: service
+containers with ``restart_policy: condition: on-failure, delay: 5s``
+(reference docker-compose.yml:14-15) that gate their start on their
+dependencies being reachable (``dockerize -wait``, docker-compose.yml:145).
+This supervisor is that stack without the swarm:
+
+- starts the store server, then blocks until its ``GET /health``
+  answers (the dockerize gate);
+- starts one ``LO_SERVICE=<name>`` runner process per service, all
+  pointed at the store via ``LO_STORE_URL``;
+- restarts any child that exits non-zero after a delay (the
+  restart_policy), indefinitely by default;
+- writes ``<data_dir>/stack_ports.json`` (``{"ports": {service: port},
+  "pids": {service: pid}}``, refreshed on restart) so clients, tests
+  and operators can discover the stack regardless of ephemeral-port
+  mode;
+- forwards SIGTERM/SIGINT to the children and exits cleanly.
+
+Usage::
+
+    python deploy/stack.py [data_dir]
+
+Environment (all optional):
+
+- ``LO_DATA_DIR``       store WAL dir (default ./lo_data or argv[1])
+- ``LO_HOST``           bind host (default 127.0.0.1 — model_builder
+                        executes request-supplied code; see deploy/README.md)
+- ``LO_STORE_PORT``     store port (default 27027; 0 = OS-assigned)
+- ``LO_EPHEMERAL``      "1" = every service binds an OS-assigned port
+                        (tests); default: reference ports 5000-5006
+- ``LO_RESTART_DELAY``  seconds between failure and restart (default 5)
+- ``LO_MAX_RESTARTS``   per-child cap (default: unlimited)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVICE_NAMES = (
+    "database_api",
+    "projection",
+    "model_builder",
+    "data_type_handler",
+    "histogram",
+    "tsne",
+    "pca",
+)
+
+# "service <name> on <host>:<port>" (services/runner.py) and
+# "store server on <host>:<port>" (core/store_service.py)
+_PORT_LINE = re.compile(r"on [\w.\-]+:(\d+)")
+
+
+class Child:
+    """One supervised process with an on-failure restart policy."""
+
+    def __init__(self, name: str, argv: list[str], env: dict, log):
+        self.name = name
+        self.argv = argv
+        self.env = env
+        self.log = log
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+        self.restarts = 0
+        self._port_event = threading.Event()
+
+    def start(self) -> None:
+        self.proc = subprocess.Popen(
+            self.argv,
+            env=self.env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self) -> None:
+        proc = self.proc
+        for line in proc.stdout:
+            match = _PORT_LINE.search(line)
+            if match:
+                self.port = int(match.group(1))
+                self._port_event.set()
+            self.log(f"[{self.name}] {line.rstrip()}")
+
+    def wait_port(self, timeout: float) -> int:
+        if not self._port_event.wait(timeout):
+            raise TimeoutError(f"{self.name}: no port line within {timeout}s")
+        return self.port
+
+    def terminate(self) -> None:
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+
+def wait_health(url: str, timeout: float) -> None:
+    """The dockerize -wait analogue: block until the store answers."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except OSError:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"store not healthy at {url} within {timeout}s")
+
+
+def main() -> int:
+    data_dir = os.path.abspath(
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
+    )
+    host = os.environ.get("LO_HOST", "127.0.0.1")
+    store_port = os.environ.get("LO_STORE_PORT", "27027")
+    ephemeral = os.environ.get("LO_EPHEMERAL") == "1"
+    restart_delay = float(os.environ.get("LO_RESTART_DELAY", "5"))
+    max_restarts = os.environ.get("LO_MAX_RESTARTS")
+    max_restarts = int(max_restarts) if max_restarts else None
+    os.makedirs(data_dir, exist_ok=True)
+    ports_path = os.path.join(data_dir, "stack_ports.json")
+
+    log_lock = threading.Lock()
+
+    def log(line: str) -> None:
+        with log_lock:
+            print(line, flush=True)
+
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO_ROOT + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env["PYTHONUNBUFFERED"] = "1"
+    base_env["LO_DATA_DIR"] = data_dir
+    base_env["LO_HOST"] = host
+
+    store_env = dict(base_env)
+    store_env["LO_STORE_PORT"] = store_port
+    store = Child(
+        "store",
+        [sys.executable, "-m", "learningorchestra_tpu.core.store_service"],
+        store_env,
+        log,
+    )
+
+    children: dict[str, Child] = {"store": store}
+
+    def write_ports() -> None:
+        state = {
+            "ports": {
+                name: child.port
+                for name, child in children.items()
+                if child.port is not None
+            },
+            "pids": {
+                name: child.proc.pid
+                for name, child in children.items()
+                if child.proc is not None and child.poll() is None
+            },
+        }
+        tmp = ports_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, ports_path)
+
+    # Handlers installed before the first child starts: a SIGTERM during
+    # the multi-minute bring-up must still tear everything down (the
+    # try/finally below owns cleanup for bring-up failures too).
+    stopping = threading.Event()
+
+    def shutdown(signum, frame):
+        stopping.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    try:
+        exit_code = _supervise(
+            children,
+            store,
+            base_env,
+            host,
+            ephemeral,
+            restart_delay,
+            max_restarts,
+            write_ports,
+            ports_path,
+            stopping,
+            log,
+        )
+    finally:
+        log("[stack] shutting down")
+        for child in children.values():
+            child.terminate()
+        deadline = time.time() + 10
+        for child in children.values():
+            if child.proc:
+                try:
+                    child.proc.wait(max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    child.proc.kill()
+    return exit_code
+
+
+def _supervise(
+    children,
+    store,
+    base_env,
+    host,
+    ephemeral,
+    restart_delay,
+    max_restarts,
+    write_ports,
+    ports_path,
+    stopping,
+    log,
+) -> int:
+    store.start()
+    store_live_port = store.wait_port(60)
+    store_url = f"http://{host}:{store_live_port}"
+    wait_health(store_url, 60)
+    log(f"[stack] store healthy at {store_url}")
+
+    for name in SERVICE_NAMES:
+        env = dict(base_env)
+        env["LO_SERVICE"] = name
+        env["LO_STORE_URL"] = store_url
+        if ephemeral:
+            env["LO_PORT"] = "0"
+        child = Child(
+            name,
+            [sys.executable, "-m", "learningorchestra_tpu.services.runner"],
+            env,
+            log,
+        )
+        children[name] = child
+        child.start()
+    for name in SERVICE_NAMES:
+        children[name].wait_port(120)
+    write_ports()
+    log(f"[stack] all services up; ports in {ports_path}")
+
+    retired: set = set()
+    exit_code = 0
+    while not stopping.is_set():
+        time.sleep(0.5)
+        for name, child in children.items():
+            code = child.poll()
+            if code is None or name in retired or stopping.is_set():
+                continue
+            if code == 0:
+                log(f"[stack] {name} exited cleanly; not restarting")
+                retired.add(name)
+                child.port = None
+                write_ports()
+                continue
+            if max_restarts is not None and child.restarts >= max_restarts:
+                log(
+                    f"[stack] {name} failed (rc={code}) after "
+                    f"{child.restarts} restarts; giving up"
+                )
+                stopping.set()
+                exit_code = 1
+                break
+            child.restarts += 1
+            log(
+                f"[stack] {name} failed (rc={code}); restart "
+                f"#{child.restarts} in {restart_delay}s"
+            )
+            time.sleep(restart_delay)
+            child._port_event.clear()
+            child.port = None
+            if name == "store":
+                child.start()
+                new_port = child.wait_port(60)
+                new_url = f"http://{host}:{new_port}"
+                wait_health(new_url, 60)
+                # Ephemeral store ports can move across restarts; the
+                # services' LO_STORE_URL is fixed at their spawn, so
+                # only restart-in-place topologies (fixed store port)
+                # keep the wiring valid — the default.
+                if new_url != store_url:
+                    log(
+                        "[stack] store moved to "
+                        f"{new_url}; restarting services to rewire"
+                    )
+                    store_url = new_url
+                    for svc_name in SERVICE_NAMES:
+                        svc = children[svc_name]
+                        svc.terminate()
+                        svc.env["LO_STORE_URL"] = store_url
+            else:
+                child.start()
+                child.wait_port(120)
+            write_ports()
+
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,8 @@ are written back in batched columnar writes, not 1 RPC per row.
 from __future__ import annotations
 
 import os
+import threading
+import time
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Optional
 
@@ -29,10 +31,13 @@ from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
 from learningorchestra_tpu.ml.evaluation import accuracy_score, f1_score
-from learningorchestra_tpu.utils.profiling import PhaseTimer
+from learningorchestra_tpu.utils.profiling import PhaseTimer, trace
 
 FEATURES_COL = "features"
 LABEL_COL = "label"
+
+# Guards the process-global JAX profiler (see build_model's trace note).
+_TRACE_LOCK = threading.Lock()
 
 
 def load_dataframe(store: DocumentStore, filename: str) -> DataFrame:
@@ -209,8 +214,49 @@ def build_model(
     max_workers = (
         1 if jax.process_count() > 1 else len(classificators_list) or 1
     )
+    # LO_TRACE_DIR: device-level tracing of the whole fan-out (fits,
+    # predictions, writes) into a TensorBoard/Perfetto profile dir —
+    # one timestamped capture per build, named after the test dataset.
+    # The JAX profiler is process-global and non-reentrant, so a build
+    # that overlaps an active capture runs untraced rather than failing:
+    # tracing is observability, never a reason to 500 a request.
+    trace_root = os.environ.get("LO_TRACE_DIR")
+    trace_dir = None
+    tracing = trace_root and _TRACE_LOCK.acquire(blocking=False)
+    if tracing:
+        trace_dir = os.path.join(
+            trace_root, f"build_{test_filename}_{int(time.time() * 1000)}"
+        )
+    try:
+        return _build_model_traced(
+            store,
+            out,
+            classificators_list,
+            test_filename,
+            mesh,
+            write_outputs,
+            models_dir,
+            max_workers,
+            trace_dir,
+        )
+    finally:
+        if tracing:
+            _TRACE_LOCK.release()
+
+
+def _build_model_traced(
+    store,
+    out,
+    classificators_list,
+    test_filename,
+    mesh,
+    write_outputs,
+    models_dir,
+    max_workers,
+    trace_dir,
+) -> list[dict]:
     results: list[dict] = []
-    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+    with trace(trace_dir), ThreadPoolExecutor(max_workers=max_workers) as pool:
         futures = [
             pool.submit(
                 train_one,
@@ -235,6 +281,7 @@ def build_model(
 def predict_with_model(
     store: DocumentStore,
     checkpoint_path: str,
+    training_filename: str,
     test_filename: str,
     preprocessor_code: str,
     prediction_filename: str,
@@ -243,16 +290,21 @@ def predict_with_model(
 ) -> dict:
     """Serve predictions from a saved checkpoint — no refit.
 
-    Loads the artifact :func:`train_one` persisted, runs the same
-    preprocessor over the test dataset, predicts, and writes the
-    prediction collection in the same shape build_model produces. This
-    is the resume path the reference cannot offer: its fitted models
-    die with the request (model_builder.py:232-247)."""
+    Loads the artifact :func:`train_one` persisted, re-runs the same
+    preprocessor over the same (training, test) frames — the training
+    frame is required because preprocessor state is derived from it
+    (StringIndexer category order, assembler column lists, imputation
+    stats); feeding the test frame in its place would silently permute
+    or reshape features. Then predicts and writes the prediction
+    collection in the same shape build_model produces. This is the
+    resume path the reference cannot offer: its fitted models die with
+    the request (model_builder.py:232-247)."""
     from learningorchestra_tpu.ml.checkpoint import load_model
 
     model = load_model(checkpoint_path, mesh=mesh)
+    training_df = load_dataframe(store, training_filename)
     testing_df = load_dataframe(store, test_filename)
-    out = run_preprocessor(preprocessor_code, testing_df, testing_df)
+    out = run_preprocessor(preprocessor_code, training_df, testing_df)
 
     metadata = {
         "filename": prediction_filename,

@@ -61,6 +61,13 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
         )
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
+    @app.route("/jobs", methods=("GET",))
+    def read_jobs(request):
+        # Observability beyond the reference: every async job's state
+        # (PENDING/RUNNING/FINISHED/FAILED, timings, error) inspectable
+        # over REST instead of only via each collection's metadata row.
+        return {MESSAGE_RESULT: jobs.all_jobs()}, 200
+
     @app.route("/files/<filename>", methods=("GET",))
     def read_file(request, filename):
         try:

@@ -72,6 +72,7 @@ def create_app(
             predict_with_model(
                 store,
                 checkpoint_path(model_name),
+                body["training_filename"],
                 body["test_filename"],
                 body["preprocessor_code"],
                 body["prediction_filename"],
@@ -137,7 +138,15 @@ def create_app(
 
     @app.route("/models/<model_name>/predictions", methods=("POST",))
     def predict_model(request, model_name):
-        body = request.get_json()
+        body = request.get_json(silent=True)
+        required = (
+            "training_filename",
+            "test_filename",
+            "preprocessor_code",
+            "prediction_filename",
+        )
+        if not isinstance(body, dict) or any(k not in body for k in required):
+            return {MESSAGE_RESULT: validators.MESSAGE_MISSING_FIELDS}, 406
         if (
             not models_dir
             or not validators.safe_filename(model_name)
@@ -145,6 +154,11 @@ def create_app(
         ):
             return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
         try:
+            validators.filename_exists(
+                store,
+                body["training_filename"],
+                validators.MESSAGE_INVALID_TRAINING_FILENAME,
+            )
             validators.filename_exists(
                 store,
                 body["test_filename"],

@@ -105,6 +105,7 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
         predict_with_model(
             store,
             payload["checkpoint_path"],
+            payload["training_filename"],
             payload["test_filename"],
             payload["preprocessor_code"],
             payload["prediction_filename"],
@@ -164,6 +165,7 @@ def build_app(
                     "predict_model",
                     {
                         "checkpoint_path": _ckpt(models_dir, model_name),
+                        "training_filename": body["training_filename"],
                         "test_filename": body["test_filename"],
                         "preprocessor_code": body["preprocessor_code"],
                         "prediction_filename": body["prediction_filename"],

@@ -109,6 +109,7 @@ class TestCheckpointWiring:
         response = reloaded.post(
             f"/models/{name}/predictions",
             json={
+                "training_filename": "ck_train",
                 "test_filename": "ck_test",
                 "preprocessor_code": DOCUMENTED_PREPROCESSOR,
                 "prediction_filename": "ck_reloaded",
@@ -131,6 +132,7 @@ class TestCheckpointWiring:
         response = client.post(
             "/models/nope/predictions",
             json={
+                "training_filename": "t",
                 "test_filename": "x",
                 "preprocessor_code": "",
                 "prediction_filename": "y",
@@ -178,6 +180,22 @@ class TestPhaseTimer:
         )
         timings = results[0]["timings"]
         assert {"fit", "evaluate", "predict"} <= set(timings)
+
+    def test_trace_dir_written(self, store, titanic_csv, tmp_path, monkeypatch):
+        """LO_TRACE_DIR captures a device profile of the build fan-out
+        (TensorBoard/Perfetto-loadable), one dir per build."""
+        from learningorchestra_tpu.ml.builder import build_model
+        from tests.test_frame import DOCUMENTED_PREPROCESSOR
+
+        TestCheckpointWiring()._ingest(store, titanic_csv)
+        trace_root = tmp_path / "traces"
+        monkeypatch.setenv("LO_TRACE_DIR", str(trace_root))
+        build_model(
+            store, "ck_train", "ck_test", DOCUMENTED_PREPROCESSOR, ["nb"]
+        )
+        captures = list(trace_root.glob("build_ck_test_*"))
+        assert len(captures) == 1 and captures[0].is_dir()
+        assert any(p.is_file() for p in captures[0].rglob("*"))
 
     def test_roundtrip_with_non_npz_extension(self, data, tmp_path):
         X, y = data

@@ -42,6 +42,18 @@ class TestDatabaseApi:
         meta = body(response)["result"][0]
         assert meta["finished"] is True and meta["filename"] == "titanic"
 
+    def test_jobs_endpoint(self, store, titanic_csv):
+        jobs = JobManager()
+        client = database_api.create_app(store, jobs).test_client()
+        assert body(client.get("/jobs")) == {"result": []}
+        client.post("/files", json={"filename": "titanic", "url": titanic_csv})
+        jobs.wait("ingest:titanic", timeout=30)
+        listing = body(client.get("/jobs"))["result"]
+        assert len(listing) == 1
+        job = listing[0]
+        assert job["name"] == "ingest:titanic"
+        assert job["state"] == "finished"
+
     def test_invalid_url_406(self, store, tmp_path):
         bad = tmp_path / "bad.html"
         bad.write_text("<html></html>")
