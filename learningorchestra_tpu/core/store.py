@@ -291,12 +291,20 @@ class DocumentStore:
 
     # --- columnar data plane --------------------------------------------------
     def read_columns(
-        self, collection: str, fields: Optional[list[str]] = None
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
     ) -> dict[str, list]:
-        """Column-major read of all non-metadata rows, ordered by ``_id``.
+        """Column-major read of non-metadata rows, ordered by ``_id``.
 
         Returns ``{field: [values...]}``. This is the storage→device path:
         one bulk call instead of the reference's per-row RPCs.
+        ``start``/``limit`` slice the row range (after metadata exclusion)
+        so wire backends can page large datasets in bounded chunks;
+        field-name discovery under ``fields=None`` always scans every row
+        (a chunk must not change the column set).
         """
         rows = [
             document
@@ -310,6 +318,8 @@ class DocumentStore:
                     if key not in names and key != ROW_ID:
                         names.append(key)
             fields = names
+        stop = None if limit is None else start + limit
+        rows = rows[start:stop]
         return {
             field: [row.get(field) for row in rows] for field in fields
         }
@@ -324,13 +334,16 @@ class DocumentStore:
 
 
 def _group_count(documents: Iterator[dict], field: str) -> list[dict]:
+    # Keys carry a bool tag: True hashes equal to 1, and a plain dict
+    # would merge the two groups (Mongo keeps true and 1 distinct).
     counts: dict[Any, int] = {}
     for document in documents:
         if document.get(ROW_ID) == METADATA_ID:
             continue
-        key = document.get(field)
+        value = document.get(field)
+        key = (isinstance(value, bool), value)
         counts[key] = counts.get(key, 0) + 1
-    return [{"_id": key, "count": count} for key, count in counts.items()]
+    return [{"_id": key[1], "count": count} for key, count in counts.items()]
 
 
 def _is_int_id(doc_id: Any) -> bool:
@@ -897,6 +910,17 @@ class InMemoryStore(DocumentStore):
                             values = [
                                 None if v is _MISSING else v for v in values
                             ]
+                    if any(type(value) is bool for value in values):
+                        # True hashes equal to 1; Counter would merge
+                        # the groups. Tag keys like _group_count does.
+                        counts: dict = {}
+                        for value in values:
+                            key = (isinstance(value, bool), value)
+                            counts[key] = counts.get(key, 0) + 1
+                        return [
+                            {"_id": key[1], "count": count}
+                            for key, count in counts.items()
+                        ]
                     return [
                         {"_id": key, "count": count}
                         for key, count in Counter(values).items()
@@ -916,21 +940,33 @@ class InMemoryStore(DocumentStore):
         return results
 
     def read_columns(
-        self, collection: str, fields: Optional[list[str]] = None
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
     ) -> dict[str, list]:
         with self._lock:
             col = self._collections.get(collection)
             if col is None:
                 return {field: [] for field in fields} if fields else {}
             if not col.overlay_data_ids():
-                # Pure-block dataset: hand back column copies directly.
+                # Pure-block dataset: hand back column slices directly —
+                # a paged read costs O(chunk), not O(rows).
+                stop = (
+                    col.block_rows
+                    if limit is None
+                    else min(start + limit, col.block_rows)
+                )
                 names = fields if fields is not None else list(col.block_fields)
                 out: dict[str, list] = {}
                 for name in names:
                     if name == ROW_ID:
-                        out[name] = list(range(col.block_start, col.block_stop))
+                        out[name] = list(
+                            range(col.block_start + start, col.block_start + stop)
+                        )
                     elif name in col.block_columns:
-                        column = col.block_columns[name]
+                        column = col.block_columns[name][start:stop]
                         if name in col.padded_fields:
                             # parity with row.get(field): pads read as None
                             out[name] = [
@@ -939,10 +975,34 @@ class InMemoryStore(DocumentStore):
                         else:
                             out[name] = list(column)
                     else:
-                        out[name] = [None] * col.block_rows
+                        out[name] = [None] * max(stop - start, 0)
                 return out
-        # Mixed block + overlay rows: fall back to the row-merge path.
-        return super().read_columns(collection, fields)
+            # Mixed block + overlay rows: page over the merged id order,
+            # synthesizing row dicts ONLY for the requested slice — a
+            # paged read costs O(ids + chunk), never O(rows) dict
+            # synthesis per chunk (the wire loop would otherwise go
+            # quadratic on a block dataset with one stray overlay row).
+            view = col.snapshot()
+        data_ids = [
+            doc_id for doc_id in view.iter_ids() if doc_id != METADATA_ID
+        ]
+        if fields is None:
+            names = [f for f in view.block_fields if f != ROW_ID]
+            seen = set(names)
+            for doc_id in data_ids:
+                if doc_id in view.rows:
+                    for key in view.rows[doc_id]:
+                        if key != ROW_ID and key not in seen:
+                            seen.add(key)
+                            names.append(key)
+            fields = names
+        stop_index = None if limit is None else start + limit
+        out = {field: [] for field in fields}
+        for doc_id in data_ids[start:stop_index]:
+            document = view.document(doc_id)
+            for field in fields:
+                out[field].append(document.get(field))
+        return out
 
 
 _GLOBAL_STORE: Optional[InMemoryStore] = None

@@ -150,7 +150,13 @@ def create_store_app(store: DocumentStore) -> WebApp:
     @app.route("/c/<name>/read_columns", methods=("POST",))
     @guarded
     def read_columns(request, name):
-        columns = store.read_columns(name, request.get_json().get("fields"))
+        body = request.get_json()
+        columns = store.read_columns(
+            name,
+            body.get("fields"),
+            start=body.get("start", 0),
+            limit=body.get("limit"),
+        )
         return {"columns": columns}, 200
 
     @app.route("/c/<name>/aggregate", methods=("POST",))
@@ -177,9 +183,20 @@ class RemoteStore(DocumentStore):
     independent containers sharing one database (reference:
     docker-compose.yml:173-330)."""
 
-    def __init__(self, base_url: str, timeout: float = 600.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 600.0,
+        wire_rows: Optional[int] = None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # Rows per read_columns wire chunk (LO_WIRE_ROWS): bounds every
+        # JSON body the data plane ships, mirroring the write batching
+        # in core/table.py insert_columns_batched.
+        self.wire_rows = max(
+            1, wire_rows or int(os.environ.get("LO_WIRE_ROWS", "100000"))
+        )
         self._local = threading.local()
 
     # one session per thread: requests.Session pools connections but is
@@ -285,11 +302,44 @@ class RemoteStore(DocumentStore):
         return iter(payload["documents"])
 
     def read_columns(
-        self, collection: str, fields: Optional[list[str]] = None
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
     ) -> dict[str, list]:
-        return self._post(f"/c/{collection}/read_columns", {"fields": fields})[
-            "columns"
-        ]
+        """Paged on the wire: rows travel in ``wire_rows`` chunks (the
+        read half of ``insert_columns_batched``'s write batching), so a
+        10M-row dataset never rides one giant JSON body. The chunk loop
+        stops at a short chunk; an explicit ``limit`` caps the total."""
+        out: dict[str, list] = {}
+        fetched = 0
+        while True:
+            chunk_limit = self.wire_rows
+            if limit is not None:
+                chunk_limit = min(chunk_limit, limit - fetched)
+                if chunk_limit <= 0:
+                    break
+            chunk = self._post(
+                f"/c/{collection}/read_columns",
+                {
+                    "fields": fields,
+                    "start": start + fetched,
+                    "limit": chunk_limit,
+                },
+            )["columns"]
+            if not out:
+                out = {name: list(values) for name, values in chunk.items()}
+            else:
+                for name, values in chunk.items():
+                    out[name].extend(values)
+            chunk_rows = max((len(v) for v in chunk.values()), default=0)
+            fetched += chunk_rows
+            # Short chunk = exhausted; empty chunk breaks unconditionally
+            # so a degenerate chunk_limit can never spin forever.
+            if chunk_rows < chunk_limit or chunk_rows == 0:
+                break
+        return out
 
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
         return self._post(f"/c/{collection}/aggregate", {"pipeline": pipeline})[

@@ -36,7 +36,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from learningorchestra_tpu.ml.base import (
     FittedModel,
@@ -45,6 +45,7 @@ from learningorchestra_tpu.ml.base import (
     resolve_mesh,
 )
 from learningorchestra_tpu.ml.binning import MAX_BINS, apply_bins, make_thresholds
+from learningorchestra_tpu.parallel.mesh import MODEL_AXIS, model_size
 from learningorchestra_tpu.parallel.multihost import fetch
 
 MAX_DEPTH = 5          # MLlib default maxDepth
@@ -295,9 +296,14 @@ def _dt_fit(bins, y, weights, num_classes, max_depth, max_bins):
 
 @partial(
     jax.jit,
-    static_argnames=("num_classes", "max_depth", "max_bins", "num_trees", "subset_k"),
+    static_argnames=(
+        "num_classes", "max_depth", "max_bins", "num_trees", "subset_k", "mesh"
+    ),
 )
-def _rf_fit(bins, y, weights, key, num_classes, max_depth, max_bins, num_trees, subset_k):
+def _rf_fit(
+    bins, y, weights, key, num_classes, max_depth, max_bins, num_trees,
+    subset_k, mesh=None,
+):
     base_one_hot = jax.nn.one_hot(y, num_classes, dtype=jnp.float32)
 
     def one_tree(tree_key):
@@ -310,7 +316,30 @@ def _rf_fit(bins, y, weights, key, num_classes, max_depth, max_bins, num_trees, 
             bins, one_hot, max_depth, max_bins, subset_key, subset_k
         )
 
-    return jax.vmap(one_tree)(jax.random.split(key, num_trees))
+    keys = jax.random.split(key, num_trees)
+    # Tensor parallelism over TREES: the vmap axis is sharded on the
+    # mesh's model axis (when it divides evenly), so a (data, model)
+    # mesh grows trees 2D-parallel — each device builds the histograms
+    # for its tree shard over its row shard, and XLA psums the
+    # histograms over the data axis only. Uneven splits replicate, like
+    # LR's class axis.
+    specs = None
+    if mesh is not None and num_trees % model_size(mesh) == 0:
+        specs = (
+            NamedSharding(mesh, P(MODEL_AXIS, None)),       # features heap
+            NamedSharding(mesh, P(MODEL_AXIS, None)),       # split-bin heap
+            NamedSharding(mesh, P(MODEL_AXIS, None, None)), # leaf probs
+        )
+        keys = jax.lax.with_sharding_constraint(
+            keys, NamedSharding(mesh, P(MODEL_AXIS))
+        )
+    out = jax.vmap(one_tree)(keys)
+    if specs is not None:
+        out = tuple(
+            jax.lax.with_sharding_constraint(array, spec)
+            for array, spec in zip(out, specs)
+        )
+    return out
 
 
 @partial(jax.jit, static_argnames=("max_depth", "max_bins", "rounds"))
@@ -416,6 +445,7 @@ class RandomForestClassifier:
             self.max_bins,
             self.num_trees,
             subset_k,
+            mesh=self.mesh,
         )
         thresholds_heap = _heap_thresholds(
             features_heap, bins_heap, jnp.asarray(thresholds, jnp.float32)

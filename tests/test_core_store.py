@@ -273,6 +273,49 @@ class TestColumnarBlock:
         with pytest.raises(ValueError):
             store.insert_columns("ds", {"a": [9]}, start_id=99)
 
+    def test_group_keeps_bool_distinct_from_int(self, store):
+        pipeline = [{"$group": {"_id": "$v", "count": {"$sum": 1}}}]
+        # block fast path
+        store.insert_columns("blk", {"v": [1, True, 1, False, 0]})
+        groups = {
+            (isinstance(g["_id"], bool), g["_id"]): g["count"]
+            for g in store.aggregate("blk", pipeline)
+        }
+        assert groups == {
+            (False, 1): 2, (True, True): 1, (True, False): 1, (False, 0): 1
+        }
+        # row path (overlay rows force _group_count)
+        store.insert_one("rows", {"v": 1})
+        store.insert_one("rows", {"v": True})
+        row_groups = {
+            (isinstance(g["_id"], bool), g["_id"]): g["count"]
+            for g in store.aggregate("rows", pipeline)
+        }
+        assert row_groups == {(False, 1): 1, (True, True): 1}
+
+    def test_read_columns_start_limit_block_path(self, store):
+        store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": True})
+        store.insert_columns("ds", {"a": list(range(10, 20))})
+        assert store.read_columns("ds", ["a", ROW_ID], start=2, limit=3) == {
+            "a": [12, 13, 14],
+            ROW_ID: [3, 4, 5],
+        }
+        # past-the-end start and oversize limit clamp, not raise
+        assert store.read_columns("ds", ["a"], start=8, limit=99) == {
+            "a": [18, 19]
+        }
+        assert store.read_columns("ds", ["a"], start=50, limit=5) == {"a": []}
+
+    def test_read_columns_start_limit_row_path(self, store):
+        # overlay rows force the row-merge fallback; same slicing contract
+        store.insert_one("ds", {ROW_ID: METADATA_ID, "finished": True})
+        store.insert_columns("ds", {"a": list(range(5))})
+        store.insert_one("ds", {"a": 99})  # overlay append
+        assert store.read_columns("ds", ["a"], start=3, limit=2) == {
+            "a": [3, 4]
+        }
+        assert store.read_columns("ds", ["a"], start=5) == {"a": [99]}
+
     def test_insert_columns_ragged_rejected(self, store):
         with pytest.raises(ValueError):
             store.insert_columns("ds", {"a": [1], "b": [1, 2]})

@@ -66,12 +66,30 @@ class TestMeshShapeInvariance:
     def test_rf_same_accuracy(self, data):
         X, y = data
         accuracies = []
-        for mesh in (make_mesh(data=1, model=1), make_mesh(data=8, model=1)):
+        for mesh in (
+            make_mesh(data=1, model=1),
+            make_mesh(data=8, model=1),
+            # trees sharded over the model axis (10 trees / 2 shards)
+            make_mesh(data=4, model=2),
+        ):
             model = RandomForestClassifier(num_trees=10, mesh=mesh).fit(X, y)
             accuracies.append(accuracy_score(y, model.predict(X)))
         # same seed, same binning; bootstrap draws are identical so the
         # forests match up to padded-row scatter order
         assert abs(accuracies[0] - accuracies[1]) < 0.02
+        assert abs(accuracies[0] - accuracies[2]) < 0.02
+
+    def test_rf_tree_axis_actually_sharded(self, data):
+        from learningorchestra_tpu.parallel.mesh import MODEL_AXIS
+
+        X, y = data
+        mesh = make_mesh(data=4, model=2)
+        model = RandomForestClassifier(num_trees=10, mesh=mesh).fit(X, y)
+        sharding = model.features_heap.sharding
+        assert sharding.spec[0] == MODEL_AXIS
+        # 5 trees per model shard, on distinct device groups
+        shard_rows = {s.data.shape[0] for s in model.features_heap.addressable_shards}
+        assert shard_rows == {5}
 
     def test_gbt_same_accuracy(self, data):
         X, y = data

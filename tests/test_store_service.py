@@ -65,6 +65,44 @@ class TestRemoteStoreConformance:
         docs = list(remote_store.find("ds", {"x": {"$gte": 5}}, skip=1, limit=2))
         assert [d["x"] for d in docs] == [6, 7]
 
+    def test_read_columns_paged_on_wire(self, remote_store):
+        """The read data plane travels in bounded chunks: with a tiny
+        wire_rows the same columns come back from multiple small bodies,
+        byte-identical to one big read."""
+        remote_store.insert_columns(
+            "ds", {"a": list(range(25)), "b": [str(i) for i in range(25)]}
+        )
+        calls = []
+        original = remote_store._post
+
+        def counting_post(path, payload):
+            if path.endswith("/read_columns"):
+                calls.append(payload)
+            return original(path, payload)
+
+        remote_store._post = counting_post
+        try:
+            remote_store.wire_rows = 7
+            paged = remote_store.read_columns("ds", ["a", "b"])
+            remote_store.wire_rows = 100000
+            full = remote_store.read_columns("ds", ["a", "b"])
+        finally:
+            remote_store._post = original
+        assert paged == full
+        assert len(calls) >= 4  # 25 rows / 7 per chunk
+        assert all(c["limit"] <= 7 for c in calls[:4])
+
+    def test_read_columns_start_limit(self, remote_store):
+        remote_store.insert_columns("ds", {"x": list(range(10))})
+        assert remote_store.read_columns("ds", ["x"], start=3, limit=4) == {
+            "x": [3, 4, 5, 6]
+        }
+
+    def test_degenerate_wire_rows_never_spins(self, remote_store):
+        remote_store.insert_columns("ds", {"x": [1, 2]})
+        remote_store.wire_rows = 0  # e.g. LO_WIRE_ROWS misconfigured
+        assert remote_store.read_columns("ds", ["x"])["x"] == []
+
     def test_aggregate_group(self, remote_store):
         remote_store.insert_columns("ds", {"s": ["a", "b", "a"]})
         result = remote_store.aggregate(
