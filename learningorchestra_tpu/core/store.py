@@ -526,10 +526,18 @@ class InMemoryStore(DocumentStore):
     replays the log. ``compact()`` rewrites the log as a snapshot.
     """
 
-    def __init__(self, data_dir: Optional[str] = None):
+    def __init__(self, data_dir: Optional[str] = None, replicate: bool = False):
         self._lock = threading.RLock()
         self._collections: dict[str, _Collection] = {}
         self._wal = None
+        # Replication: when enabled, every WAL record (as its serialized
+        # JSON line) is also kept in an in-memory buffer so followers can
+        # fetch the log over the wire (``wal_feed``). ``_wal_epoch``
+        # bumps on every compaction — a follower whose offset belongs to
+        # a previous epoch must resync from record 0 (the compacted
+        # snapshot IS the new log prefix).
+        self._wal_buffer: Optional[list[str]] = [] if replicate else None
+        self._wal_epoch = 0
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
             wal_path = os.path.join(data_dir, "wal.jsonl")
@@ -539,9 +547,14 @@ class InMemoryStore(DocumentStore):
 
     # --- WAL ------------------------------------------------------------------
     def _log(self, record: dict) -> None:
+        if self._wal is None and self._wal_buffer is None:
+            return
+        line = json.dumps(record)
         if self._wal is not None:
-            self._wal.write(json.dumps(record) + "\n")
+            self._wal.write(line + "\n")
             self._wal.flush()
+        if self._wal_buffer is not None:
+            self._wal_buffer.append(line)
 
     def _replay(self, wal_path: str) -> None:
         with open(wal_path, encoding="utf-8") as handle:
@@ -549,34 +562,117 @@ class InMemoryStore(DocumentStore):
                 line = line.strip()
                 if not line:
                     continue
+                self._apply_record(json.loads(line))
+                if self._wal_buffer is not None:
+                    self._wal_buffer.append(line)
+
+    def _apply_record(self, record: dict) -> None:
+        """Apply one WAL record (no locking, no logging) — the single
+        switch shared by startup replay and follower replication."""
+        op = record["op"]
+        if op == "insert":
+            self._apply_insert(record["c"], record["d"])
+        elif op == "insert_many":
+            for document in record["d"]:
+                self._apply_insert(record["c"], document)
+        elif op == "insert_cols":
+            self._apply_insert_columns(
+                record["c"], record["d"], record["s"],
+                missing=record.get("m"),
+            )
+        elif op == "update":
+            self._apply_update(record["c"], record["q"], record["v"])
+        elif op == "set_field":
+            # Logged as [id, value] pairs so JSON preserves the
+            # id's type (dict keys would stringify int ids).
+            self._apply_set_field(record["c"], record["f"], dict(record["d"]))
+        elif op == "set_col":
+            self._apply_set_column(
+                record["c"], record["f"], record["d"], record["s"]
+            )
+        elif op == "create":
+            self._collections.setdefault(record["c"], _Collection())
+        elif op == "drop":
+            self._collections.pop(record["c"], None)
+        elif op == "epoch":
+            # Epoch is part of the log so it survives restarts: a
+            # follower cursor is only valid against the SAME log, and a
+            # primary that compacted then rebooted must not hand out its
+            # pre-compaction epoch (stale cursors would silently apply
+            # the wrong suffix).
+            self._wal_epoch = record["e"]
+
+    # --- replication ----------------------------------------------------------
+    @property
+    def wal_length(self) -> int:
+        """Records in the replication feed (0 when replication is off)."""
+        with self._lock:
+            return len(self._wal_buffer or ())
+
+    def wal_feed(self, epoch: int, offset: int, limit: int = 10000) -> dict:
+        """Serialized WAL records from ``(epoch, offset)`` onward.
+
+        Returns ``{"epoch", "offset", "next", "records", "resync"}`` with
+        ``records`` as raw JSON lines. A stale epoch (the primary
+        compacted since) or an impossible offset answers ``resync: True``
+        with the current epoch — the follower clears and pulls from 0,
+        where the compacted snapshot now lives.
+        """
+        with self._lock:
+            if self._wal_buffer is None:
+                raise ValueError("replication not enabled on this store")
+            if epoch != self._wal_epoch or offset > len(self._wal_buffer):
+                return {
+                    "epoch": self._wal_epoch,
+                    "offset": 0,
+                    "next": 0,
+                    "records": [],
+                    "resync": True,
+                }
+            records = self._wal_buffer[offset : offset + limit]
+            return {
+                "epoch": self._wal_epoch,
+                "offset": offset,
+                "next": offset + len(records),
+                "records": records,
+                "resync": False,
+            }
+
+    def apply_replicated(self, lines: list[str]) -> None:
+        """Follower-side ingestion: apply raw WAL lines from the primary
+        and re-log them locally (the follower's own WAL/buffer make it
+        promotable to primary with full durability)."""
+        with self._lock:
+            for line in lines:
                 record = json.loads(line)
-                op = record["op"]
-                if op == "insert":
-                    self._apply_insert(record["c"], record["d"])
-                elif op == "insert_many":
-                    for document in record["d"]:
-                        self._apply_insert(record["c"], document)
-                elif op == "insert_cols":
-                    self._apply_insert_columns(
-                        record["c"], record["d"], record["s"],
-                        missing=record.get("m"),
-                    )
-                elif op == "update":
-                    self._apply_update(record["c"], record["q"], record["v"])
-                elif op == "set_field":
-                    # Logged as [id, value] pairs so JSON preserves the
-                    # id's type (dict keys would stringify int ids).
-                    self._apply_set_field(
-                        record["c"], record["f"], dict(record["d"])
-                    )
-                elif op == "set_col":
-                    self._apply_set_column(
-                        record["c"], record["f"], record["d"], record["s"]
-                    )
-                elif op == "create":
-                    self._collections.setdefault(record["c"], _Collection())
-                elif op == "drop":
-                    self._collections.pop(record["c"], None)
+                self._apply_record(record)
+                self._log(record)
+
+    def resync_apply(self, lines: list[str]) -> None:
+        """Replace ALL state with the given WAL lines (stale-epoch
+        resync): the new log is written to a temp file and
+        ``os.replace``d over the local WAL FIRST, then memory is rebuilt
+        from it — the durable copy is never empty, so a crash at any
+        point leaves either the old replica state or the new snapshot,
+        never nothing."""
+        with self._lock:
+            if self._wal is not None:
+                path = self._wal.name
+                tmp_path = path + ".resync.tmp"
+                with open(tmp_path, "w", encoding="utf-8") as handle:
+                    handle.write("\n".join(lines) + ("\n" if lines else ""))
+                    handle.flush()
+                    os.fsync(handle.fileno())
+                self._wal.close()
+                try:
+                    os.replace(tmp_path, path)
+                finally:
+                    self._wal = open(path, "a", encoding="utf-8")
+            self._collections.clear()
+            if self._wal_buffer is not None:
+                self._wal_buffer[:] = list(lines)
+            for line in lines:
+                self._apply_record(json.loads(line))
 
     def compact(self) -> None:
         """Rewrite the WAL as a snapshot.
@@ -589,13 +685,32 @@ class InMemoryStore(DocumentStore):
         raw values because JSON has no missing/null distinction.
         """
         with self._lock:
+            if self._wal is None and self._wal_buffer is None:
+                return
+            # Serialize the snapshot ONCE; the same lines become the new
+            # in-memory feed and the new log file. The snapshot opens
+            # with an epoch record so the log carries its own identity
+            # across restarts — a follower cursor from a previous epoch
+            # must never validate against the rewritten log.
+            new_epoch = self._wal_epoch + 1
+            lines = [json.dumps({"op": "epoch", "e": new_epoch})]
+            lines.extend(
+                json.dumps(record) for record in self._snapshot_records()
+            )
+            if self._wal_buffer is not None:
+                # Replication: the compacted snapshot becomes the new log
+                # prefix under the fresh epoch; followers on the old
+                # epoch resync (wal_feed).
+                self._wal_buffer[:] = lines
+            self._wal_epoch = new_epoch
             if self._wal is None:
                 return
             path = self._wal.name
             tmp_path = path + ".compact.tmp"
             try:
                 with open(tmp_path, "w", encoding="utf-8") as handle:
-                    self._write_snapshot(handle)
+                    for line in lines:
+                        handle.write(line + "\n")
                     handle.flush()
                     os.fsync(handle.fileno())  # data durable before rename
             except BaseException:
@@ -619,9 +734,11 @@ class InMemoryStore(DocumentStore):
                 # writes never hit a closed handle.
                 self._wal = open(path, "a", encoding="utf-8")
 
-    def _write_snapshot(self, handle) -> None:
+    def _snapshot_records(self) -> Iterator[dict]:
+        """The current state as a minimal WAL record sequence — the body
+        of a compacted log (and, under replication, of a new epoch)."""
         for name, col in self._collections.items():
-            handle.write(json.dumps({"op": "create", "c": name}) + "\n")
+            yield {"op": "create", "c": name}
             if col.block_columns:
                 record = {
                     "op": "insert_cols",
@@ -643,14 +760,9 @@ class InMemoryStore(DocumentStore):
                     record["d"][field] = column
                 if missing:
                     record["m"] = missing
-                handle.write(json.dumps(record) + "\n")
+                yield record
             if col.rows:
-                handle.write(
-                    json.dumps(
-                        {"op": "insert_many", "c": name, "d": list(col.rows.values())}
-                    )
-                    + "\n"
-                )
+                yield {"op": "insert_many", "c": name, "d": list(col.rows.values())}
 
     # --- primitive ops (no locking/logging) -----------------------------------
     def _apply_insert(self, collection: str, document: dict) -> None:

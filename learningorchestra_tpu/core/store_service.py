@@ -25,17 +25,25 @@ internal contract, versioned by the framework):
 - ``POST /c/<name>/read_columns``   ``{"fields": [...]|null}`` → ``{"columns"}``
 - ``POST /c/<name>/aggregate``      ``{"pipeline": [...]}`` → ``{"results"}``
 - ``GET  /c/<name>/count``                      → ``{"count": n}``
-- ``GET  /health``                              → ``{"ok": true}``
+- ``GET  /health``                              → ``{"ok": true, "writable": bool}``
+- ``GET  /wal?epoch&offset&limit``              → WAL feed for followers
+- ``POST /promote``                             → follower becomes writable
 
 Error mapping: ``KeyError`` (duplicate ids/collections) → 409;
 ``UnsupportedQueryError`` → 400 with ``kind: unsupported_query``; other
-``ValueError`` → 400. :class:`RemoteStore` re-raises the same exception
-types, so service code behaves identically on a local or remote store.
+``ValueError`` → 400; mutation on a follower → 503. :class:`RemoteStore`
+re-raises the same exception types, so service code behaves identically
+on a local or remote store.
 
 Durability/replication posture: the server runs one WAL-backed
-:class:`InMemoryStore` (SURVEY §2 notes replication is the external
-store's concern in the reference; here the WAL is the durability story
-and the server is the single writer).
+:class:`InMemoryStore`; the WAL is the durability story and the primary
+is the single writer. HA mirrors the reference's Mongo replica set
+(docker-compose.yml:27-91) with WAL shipping: a primary started with
+``LO_REPLICATE=1`` feeds ``GET /wal``; followers started with
+``LO_PRIMARY_URL`` tail it (:class:`ReplicationClient`, the oplog-tailing
+secondary role), serve reads, reject writes with 503, and take over on
+``POST /promote`` — promotion instead of election: one HTTP call by the
+operator or supervisor instead of a quorum protocol.
 """
 
 from __future__ import annotations
@@ -57,8 +65,15 @@ from learningorchestra_tpu.utils.web import ServerThread, WebApp
 DEFAULT_STORE_PORT = 27027
 
 
-def create_store_app(store: DocumentStore) -> WebApp:
+def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebApp:
+    """``role`` (mutable, shared with the caller) carries the HA state:
+    ``{"writable": bool, "poller": ReplicationClient | None}``. A
+    follower serves every read with ``writable: False`` and answers
+    mutations with 503 until ``POST /promote`` flips it — the failover
+    the reference delegates to Mongo's replica-set election
+    (docker-compose.yml:27-91)."""
     app = WebApp("store")
+    role = role if role is not None else {"writable": True, "poller": None}
 
     def guarded(handler):
         def wrapped(request, **kwargs):
@@ -74,37 +89,80 @@ def create_store_app(store: DocumentStore) -> WebApp:
         wrapped.__name__ = handler.__name__
         return wrapped
 
+    def mutating(handler):
+        def wrapped(request, **kwargs):
+            if not role.get("writable", True):
+                return {"error": "read-only follower; POST /promote"}, 503
+            return handler(request, **kwargs)
+
+        wrapped.__name__ = handler.__name__
+        return wrapped
+
     @app.route("/health", methods=("GET",))
     def health(request):
-        return {"ok": True}, 200
+        return {"ok": True, "writable": role.get("writable", True)}, 200
+
+    @app.route("/wal", methods=("GET",))
+    def wal(request):
+        try:
+            epoch = int(request.args.get("epoch", -1))
+            offset = int(request.args.get("offset", 0))
+            limit = int(request.args.get("limit", 10000))
+        except ValueError:
+            return {"error": "epoch/offset/limit must be integers"}, 400
+        try:
+            feed = store.wal_feed(epoch, offset, limit=limit)
+        except (AttributeError, ValueError):
+            return {"error": "replication not enabled (LO_REPLICATE=1)"}, 404
+        return feed, 200
+
+    @app.route("/compact", methods=("POST",))
+    def compact(request):
+        if not hasattr(store, "compact"):
+            return {"error": "store does not support compaction"}, 404
+        store.compact()
+        return {"compacted": True}, 200
+
+    @app.route("/promote", methods=("POST",))
+    def promote(request):
+        poller = role.get("poller")
+        if poller is not None:
+            poller.stop()
+        role["writable"] = True
+        return {"promoted": True}, 200
 
     @app.route("/collections", methods=("GET",))
     def list_collections(request):
         return {"collections": store.list_collections()}, 200
 
     @app.route("/collections/<name>", methods=("POST",))
+    @mutating
     def create_collection(request, name):
         return {"created": store.create_collection(name)}, 200
 
     @app.route("/collections/<name>", methods=("DELETE",))
+    @mutating
     def drop(request, name):
         store.drop(name)
         return {}, 200
 
     @app.route("/c/<name>/insert_one", methods=("POST",))
     @guarded
+    @mutating
     def insert_one(request, name):
         store.insert_one(name, request.get_json()["document"])
         return {}, 200
 
     @app.route("/c/<name>/insert_many", methods=("POST",))
     @guarded
+    @mutating
     def insert_many(request, name):
         store.insert_many(name, request.get_json()["documents"])
         return {}, 200
 
     @app.route("/c/<name>/insert_columns", methods=("POST",))
     @guarded
+    @mutating
     def insert_columns(request, name):
         body = request.get_json()
         store.insert_columns(name, body["columns"], start_id=body.get("start_id"))
@@ -112,6 +170,7 @@ def create_store_app(store: DocumentStore) -> WebApp:
 
     @app.route("/c/<name>/update_one", methods=("POST",))
     @guarded
+    @mutating
     def update_one(request, name):
         body = request.get_json()
         store.update_one(name, body["query"], body["new_values"])
@@ -119,6 +178,7 @@ def create_store_app(store: DocumentStore) -> WebApp:
 
     @app.route("/c/<name>/set_field_values", methods=("POST",))
     @guarded
+    @mutating
     def set_field_values(request, name):
         body = request.get_json()
         store.set_field_values(name, body["field"], dict(body["values"]))
@@ -126,6 +186,7 @@ def create_store_app(store: DocumentStore) -> WebApp:
 
     @app.route("/c/<name>/set_column", methods=("POST",))
     @guarded
+    @mutating
     def set_column(request, name):
         body = request.get_json()
         store.set_column(
@@ -217,6 +278,10 @@ class RemoteStore(DocumentStore):
             if payload.get("kind") == "unsupported_query":
                 raise UnsupportedQueryError(payload.get("error", "bad query"))
             raise ValueError(payload.get("error", "bad request"))
+        if response.status_code == 503:
+            raise PermissionError(
+                response.json().get("error", "read-only follower")
+            )
         response.raise_for_status()
 
     def _post(self, path: str, body: dict) -> dict:
@@ -361,22 +426,166 @@ def connect(url: Optional[str] = None) -> DocumentStore:
     return InMemoryStore(data_dir=data_dir)
 
 
+class ReplicationClient:
+    """Follower-side WAL shipper: polls the primary's ``GET /wal`` and
+    applies new records to the local store — the role Mongo's secondary
+    oplog tailing plays in the reference's replica set
+    (docker-compose.yml:27-91). On a stale epoch (the primary
+    compacted) the local store resets and re-pulls from record 0, where
+    the compacted snapshot now lives. ``stop()`` (or ``POST /promote``
+    on the follower's server) halts shipping for failover."""
+
+    def __init__(
+        self,
+        store: InMemoryStore,
+        primary_url: str,
+        interval: float = 0.5,
+        batch: int = 10000,
+    ):
+        self.store = store
+        self.primary_url = primary_url.rstrip("/")
+        self.interval = interval
+        self.batch = batch
+        self.epoch = -1
+        self.offset = 0
+        # A resync signal only marks intent; local state is replaced
+        # atomically when the replacement records are actually in hand
+        # (resync_apply) — never truncated on the signal alone, so a
+        # primary that dies mid-resync cannot leave the follower empty.
+        self._pending_resync = True
+        self.last_error: Optional[str] = None
+        self._stop = threading.Event()
+        # Serializes apply against stop(): once stop() returns, no
+        # further records can land (promote must not race an in-flight
+        # poll into applying the old primary's records after new writes).
+        self._apply_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> int:
+        """One fetch+apply round; returns the number of records applied."""
+        response = requests.get(
+            f"{self.primary_url}/wal",
+            params={
+                "epoch": self.epoch,
+                "offset": self.offset,
+                "limit": self.batch,
+            },
+            timeout=60,
+        )
+        response.raise_for_status()
+        feed = response.json()
+        with self._apply_lock:
+            if self._stop.is_set():
+                return 0
+            if feed["resync"]:
+                self.epoch = feed["epoch"]
+                self.offset = 0
+                self._pending_resync = True
+                return 0
+            try:
+                if self._pending_resync and feed["offset"] == 0:
+                    self.store.resync_apply(feed["records"])
+                    self._pending_resync = False
+                else:
+                    self.store.apply_replicated(feed["records"])
+            except Exception:
+                # A mid-batch failure (divergence, duplicate id) leaves
+                # an ambiguous prefix applied; re-pulling the same batch
+                # would fail forever. Self-heal: force a full resync.
+                self.epoch = -1
+                self.offset = 0
+                self._pending_resync = True
+                raise
+            self.offset = feed["next"]
+            return len(feed["records"])
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                applied = self.poll_once()
+                self.last_error = None
+            except Exception as error:  # primary down: keep serving reads
+                self.last_error = str(error)
+                applied = 0
+            if applied == 0:
+                self._stop.wait(self.interval)
+
+    def start(self) -> "ReplicationClient":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Halt shipping. On return, no further records will be applied:
+        the stop flag is checked under the apply lock, so an in-flight
+        poll either finished applying before this or discards its
+        response."""
+        self._stop.set()
+        with self._apply_lock:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
 def serve(
     host: str = "127.0.0.1",
     port: int = DEFAULT_STORE_PORT,
     data_dir: Optional[str] = None,
+    replicate: bool = False,
+    primary_url: Optional[str] = None,
 ) -> ServerThread:
-    """Start a store server thread; returns it (caller stops)."""
-    store = InMemoryStore(data_dir=data_dir)
-    return ServerThread(create_store_app(store), host, port).start()
+    """Start a store server thread; returns it (caller stops).
+
+    ``replicate=True`` keeps the in-memory WAL buffer so followers can
+    ship the log; ``primary_url`` starts THIS server as a follower of
+    that primary (read-only until promoted). The server's ``role`` dict
+    and poller are attached to the returned thread as ``.store_role`` /
+    ``.replication`` for operators and tests.
+    """
+    store = InMemoryStore(
+        data_dir=data_dir, replicate=replicate or primary_url is not None
+    )
+    role = {"writable": primary_url is None, "poller": None}
+    if primary_url is not None:
+        role["poller"] = ReplicationClient(store, primary_url).start()
+    server = ServerThread(create_store_app(store, role), host, port).start()
+    server.store = store
+    server.store_role = role
+    server.replication = role["poller"]
+    if replicate and primary_url is None:
+        # The replication feed duplicates the write history in RAM;
+        # compact when it grows past LO_COMPACT_RECORDS (the snapshot
+        # replaces the history, epoch bump resyncs the followers).
+        threshold = int(os.environ.get("LO_COMPACT_RECORDS", "200000"))
+        stop = threading.Event()
+
+        def maintain():
+            while not stop.wait(10.0):
+                if store.wal_length > threshold:
+                    store.compact()
+
+        thread = threading.Thread(target=maintain, daemon=True)
+        thread.start()
+        server.compaction_stop = stop
+    return server
 
 
 def main() -> None:
     host = os.environ.get("LO_HOST", "127.0.0.1")
     port = int(os.environ.get("LO_STORE_PORT", DEFAULT_STORE_PORT))
     data_dir = os.environ.get("LO_DATA_DIR")
-    server = serve(host, port, data_dir)
-    print(f"store server on {host}:{server.port} (data_dir={data_dir})", flush=True)
+    replicate = os.environ.get("LO_REPLICATE") == "1"
+    primary_url = os.environ.get("LO_PRIMARY_URL")
+    server = serve(host, port, data_dir, replicate, primary_url)
+    mode = (
+        f"follower of {primary_url}"
+        if primary_url
+        else ("primary (replicating)" if replicate else "standalone")
+    )
+    print(
+        f"store server on {host}:{server.port} (data_dir={data_dir}, {mode})",
+        flush=True,
+    )
     server._thread.join()
 
 

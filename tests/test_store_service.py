@@ -137,6 +137,138 @@ class TestRemoteStoreConformance:
         assert remote_store.read_columns("proj", ["Name"])["Name"][0] == "Braund, Mr. Owen"
 
 
+class TestReplication:
+    """WAL-shipping HA: primary feeds /wal, follower tails it, serves
+    reads, rejects writes, survives primary compaction (epoch resync),
+    and takes over on POST /promote — the reference's Mongo replica-set
+    role (docker-compose.yml:27-91) with promotion instead of election."""
+
+    @pytest.fixture()
+    def pair(self, tmp_path):
+        from learningorchestra_tpu.core.store_service import (
+            ReplicationClient,
+            serve,
+        )
+
+        primary = serve("127.0.0.1", 0, replicate=True)
+        follower = serve(
+            "127.0.0.1",
+            0,
+            data_dir=str(tmp_path / "follower"),
+            primary_url=f"http://127.0.0.1:{primary.port}",
+        )
+        # deterministic tests: stop the auto-poller, drive a fresh
+        # (unstarted) client over the same store by hand
+        follower.replication.stop()
+        poller = ReplicationClient(
+            follower.store, f"http://127.0.0.1:{primary.port}"
+        )
+        yield (
+            RemoteStore(f"http://127.0.0.1:{primary.port}"),
+            RemoteStore(f"http://127.0.0.1:{follower.port}"),
+            poller,
+            follower,
+        )
+        primary.stop()
+        follower.stop()
+
+    def _sync(self, poller):
+        # first poll resolves the epoch (resync), then data flows
+        for _ in range(5):
+            poller.poll_once()
+
+    def test_follower_catches_up_and_rejects_writes(self, pair):
+        primary, follower, poller, _ = pair
+        primary.insert_one("ds", {ROW_ID: METADATA_ID, "finished": False})
+        primary.insert_columns("ds", {"a": [1, 2, 3]})
+        primary.update_one("ds", {ROW_ID: METADATA_ID}, {"finished": True})
+        self._sync(poller)
+        assert follower.read_columns("ds", ["a"]) == {"a": [1, 2, 3]}
+        assert follower.is_finished("ds")
+        with pytest.raises(PermissionError):
+            follower.insert_one("ds", {"a": 9})
+
+    def test_epoch_resync_after_primary_compaction(self, tmp_path):
+        """Compaction bumps the epoch; a follower mid-stream resyncs
+        from the snapshot and converges on the post-compaction state."""
+        from learningorchestra_tpu.core.store_service import (
+            ReplicationClient,
+            create_store_app,
+        )
+
+        primary_store = InMemoryStore(
+            data_dir=str(tmp_path / "primary"), replicate=True
+        )
+        server = ServerThread(
+            create_store_app(primary_store), "127.0.0.1", 0
+        ).start()
+        try:
+            follower_store = InMemoryStore(replicate=True)
+            poller = ReplicationClient(
+                follower_store, f"http://127.0.0.1:{server.port}"
+            )
+            primary_store.insert_columns("ds", {"a": list(range(6))})
+            self._sync(poller)
+            assert follower_store.read_columns("ds", ["a"])["a"] == list(
+                range(6)
+            )
+            primary_store.insert_one("ds", {"a": 6})
+            primary_store.compact()  # epoch 0 -> 1; old offset now invalid
+            primary_store.insert_one("ds", {"a": 7})
+            self._sync(poller)
+            assert poller.epoch == 1
+            values = follower_store.read_columns("ds", ["a"])["a"]
+            assert 6 in values and 7 in values and len(values) == 8
+        finally:
+            server.stop()
+
+    def test_epoch_survives_primary_restart(self, tmp_path):
+        """The epoch lives IN the log: a compacted-then-rebooted primary
+        must not reissue its pre-compaction epoch, or stale follower
+        cursors would validate against the rewritten log."""
+        data_dir = str(tmp_path / "p")
+        store = InMemoryStore(data_dir=data_dir, replicate=True)
+        store.insert_columns("ds", {"a": [1, 2]})
+        store.compact()
+        assert store._wal_epoch == 1
+        reopened = InMemoryStore(data_dir=data_dir, replicate=True)
+        assert reopened._wal_epoch == 1
+        assert reopened.read_columns("ds", ["a"]) == {"a": [1, 2]}
+
+    def test_resync_never_leaves_follower_empty(self, tmp_path):
+        """resync_apply replaces the durable WAL atomically WITH the new
+        records — a follower that crashes right after a resync reopens
+        with the snapshot state, never with nothing."""
+        data_dir = str(tmp_path / "f")
+        follower = InMemoryStore(data_dir=data_dir, replicate=True)
+        follower.insert_columns("old", {"x": [1]})
+        lines = [
+            json.dumps({"op": "create", "c": "fresh"}),
+            json.dumps({"op": "insert_cols", "c": "fresh", "s": 1,
+                        "d": {"a": [10, 11]}}),
+        ]
+        follower.resync_apply(lines)
+        assert follower.list_collections() == ["fresh"]
+        # simulate crash: reopen from disk alone
+        reopened = InMemoryStore(data_dir=data_dir)
+        assert reopened.list_collections() == ["fresh"]
+        assert reopened.read_columns("fresh", ["a"]) == {"a": [10, 11]}
+
+    def test_promote_enables_writes(self, pair):
+        primary, follower, poller, follower_server = pair
+        primary.insert_columns("ds", {"a": [1]})
+        self._sync(poller)
+        import requests as _requests
+
+        response = _requests.post(follower.base_url + "/promote")
+        assert response.json()["promoted"] is True
+        follower.insert_one("ds", {"a": 2})  # no PermissionError
+        assert follower.count("ds") == 2
+        assert _requests.get(follower.base_url + "/health").json()[
+            "writable"
+        ] is True
+
+
 def _spawn(env_extra, *argv):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
