@@ -30,8 +30,16 @@ the Titanic NaiveBayes fit: 41.87 s for 891 rows (docs/
 database_api.md:76-83) ≈ 21.28 rows/s for ONE classifier;
 ``vs_baseline`` compares the FIVE-classifier suite against it.
 
+Budgeted: the driver gives one bench invocation finite wall-clock, so
+sections spend against ``LO_BENCH_BUDGET_S`` (default 540 s) — optional
+measurements (sklearn head-to-heads, the largest scaling size, warm
+repeats) are skipped with an explicit ``"skipped"`` note once the
+budget runs low, and the headline JSON line ALWAYS prints (sections
+that fail carry an ``"error"`` instead of silencing the run).
+
 Env knobs (for smoke runs): ``LO_BENCH_ROWS`` (default 1M),
-``LO_BENCH_EMBED_ROWS`` (default 1M), ``LO_BENCH_SKLEARN`` (default 1).
+``LO_BENCH_PRODUCT_ROWS`` (default 100k), ``LO_BENCH_EMBED_ROWS``
+(default 1M), ``LO_BENCH_SKLEARN`` (default 1), ``LO_BENCH_BUDGET_S``.
 """
 
 from __future__ import annotations
@@ -44,7 +52,14 @@ import numpy as np
 
 BASELINE_ROWS_PER_SEC = 891 / 41.870062828063965  # reference anchor (1 clf)
 ROWS = int(os.environ.get("LO_BENCH_ROWS", 1_000_000))
+PRODUCT_ROWS = int(os.environ.get("LO_BENCH_PRODUCT_ROWS", 100_000))
 EMBED_ROWS = int(os.environ.get("LO_BENCH_EMBED_ROWS", 1_000_000))
+BUDGET_S = float(os.environ.get("LO_BENCH_BUDGET_S", 540))
+_START = time.monotonic()
+
+
+def _budget_left() -> float:
+    return BUDGET_S - (time.monotonic() - _START)
 RUN_SKLEARN = os.environ.get("LO_BENCH_SKLEARN", "1") == "1"
 HEAD_TO_HEAD_ROWS = 2_048  # size sklearn's exact/BH t-SNE finishes quickly
 FEATURES = 16
@@ -125,13 +140,16 @@ def bench_kernels(X, y) -> dict:
             kernel()
 
     suite()  # compile everything once
-    # Headline: best-of-3 of the WHOLE suite (same methodology as
-    # earlier rounds, so round-over-round numbers stay comparable).
-    suite_time = _best_of(suite)
-    # Diagnostics: per-kernel minima (these sum lower than the suite —
-    # they lose cross-kernel async overlap; don't compare across rounds).
+    # Headline: best-of-2 of the WHOLE suite (same best-of methodology
+    # as earlier rounds; one fewer repeat to fit the bench budget — a
+    # min over fewer repeats can only read slower, never flatter).
+    suite_time = _best_of(suite, repeats=2)
+    # Diagnostics: one timed pass per kernel (these sum lower than the
+    # suite — they lose cross-kernel async overlap; don't compare across
+    # rounds).
     per_classifier = {
-        name: round(_best_of(kernel), 4) for name, kernel in kernels.items()
+        name: round(_best_of(kernel, repeats=1), 4)
+        for name, kernel in kernels.items()
     }
     rows = len(X)
     lr_flops_lower = 100 * 4 * rows * FEATURES * CLASSES  # 2 matmuls/iter
@@ -146,10 +164,16 @@ def bench_kernels(X, y) -> dict:
 
 
 def bench_product(X, y) -> dict:
-    """Section 2: the store→builder→store path a service request takes."""
+    """Section 2: the store→builder→store path a service request takes.
+
+    Runs at ``PRODUCT_ROWS`` (default 100k): the wall-clock here is
+    dominated by the store/host sides (Python column conversion, JSON-
+    shaped writes) which scale linearly — 100k gives the same per-phase
+    shape as 1M at a fifth of the budget."""
     from learningorchestra_tpu.core.store import InMemoryStore
     from learningorchestra_tpu.ml.builder import build_model
 
+    X, y = X[:PRODUCT_ROWS], y[:PRODUCT_ROWS]
     store = InMemoryStore()
     rows = len(X)
     start = time.perf_counter()
@@ -232,7 +256,7 @@ def bench_embeddings() -> dict:
         "rows": HEAD_TO_HEAD_ROWS,
         "tsne_ours_s": round(ours_tsne_small, 3),
     }
-    if RUN_SKLEARN:
+    if RUN_SKLEARN and _budget_left() > 120:
         import sklearn.manifold
 
         start = time.perf_counter()
@@ -240,6 +264,8 @@ def bench_embeddings() -> dict:
         sk_tsne = time.perf_counter() - start
         head_to_head["tsne_sklearn_s"] = round(sk_tsne, 3)
         head_to_head["tsne_speedup"] = round(sk_tsne / ours_tsne_small, 1)
+    elif RUN_SKLEARN:
+        head_to_head["tsne_sklearn_s"] = "skipped_budget"
     out["head_to_head"] = head_to_head
 
     # Scaling sizes the reference's toPandas()+t-SNE path can't reach
@@ -251,17 +277,27 @@ def bench_embeddings() -> dict:
     else:  # smoke run: the knob shrinks everything
         sizes = [max(EMBED_ROWS, 1)]
     for rows in sizes:
+        # The largest size needs roughly a landmark-t-SNE plus warm
+        # repeat; skip (with a note) rather than blow the budget.
+        if _budget_left() < 150 and rows == max(sizes) and len(sizes) > 1:
+            scaling[str(rows)] = {"skipped": "budget"}
+            continue
         X_big = blobs(rows)
         run_pca = lambda: pca_embedding(X_big)  # noqa: E731
         run_pca()
         pca_s = _best_of(run_pca, repeats=2)
         run_tsne = lambda: tsne_embedding(X_big)  # noqa: E731 — landmark path
+        start = time.perf_counter()
         run_tsne()
-        tsne_s = _best_of(run_tsne, repeats=2)
+        tsne_cold = time.perf_counter() - start
+        warm_affordable = _budget_left() > 1.5 * tsne_cold
+        tsne_s = _best_of(run_tsne, repeats=1) if warm_affordable else tsne_cold
         entry = {
             "pca_s": round(pca_s, 3),
             "tsne_landmark_s": round(tsne_s, 3),
         }
+        if not warm_affordable:
+            entry["tsne_landmark_note"] = "cold_incl_compile"
         if RUN_SKLEARN:
             import sklearn.decomposition
 
@@ -314,19 +350,31 @@ def bench_mfu() -> dict:
 
 def main() -> None:
     X, y = _synthetic(ROWS)
-    kernels = bench_kernels(X, y)
-    mfu = bench_mfu()
-    lr_time = kernels["per_classifier_s"]["lr"]
-    if mfu["peak_bf16_flops"]:
+    kernels = bench_kernels(X, y)  # the headline; no guard — must run
+    extra: dict = {"kernels": kernels, "budget_s": BUDGET_S}
+
+    def section(name, fn):
+        """Optional sections never silence the headline: failures and
+        budget exhaustion are recorded, the JSON line still prints."""
+        if _budget_left() < 30:
+            extra[name] = {"skipped": "budget"}
+            return None
+        try:
+            extra[name] = fn()
+        except Exception as error:  # noqa: BLE001 — recorded, not fatal
+            extra[name] = {"error": f"{type(error).__name__}: {error}"}
+        return extra[name]
+
+    mfu = section("mfu", bench_mfu)
+    if mfu and mfu.get("peak_bf16_flops"):
         kernels["lr_fit_mfu_lower_bound"] = round(
             kernels["lr_fit_flops_lower_bound"]
-            / lr_time
+            / kernels["per_classifier_s"]["lr"]
             / mfu["peak_bf16_flops"],
             6,
         )
-    product = bench_product(X, y)
-    del X, y
-    embeddings = bench_embeddings()
+    section("product_path", lambda: bench_product(X, y))
+    section("embeddings", bench_embeddings)
 
     rows_per_sec = kernels["rows_per_sec"]
     print(
@@ -336,12 +384,7 @@ def main() -> None:
                 "value": rows_per_sec,
                 "unit": "rows/s",
                 "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 1),
-                "extra": {
-                    "kernels": kernels,
-                    "product_path": product,
-                    "embeddings": embeddings,
-                    "mfu": mfu,
-                },
+                "extra": extra,
             }
         )
     )

@@ -44,12 +44,25 @@ def _loss_fn(params, X, y, mask, l2):
 @partial(jax.jit, static_argnames=("max_iter",))
 def _fit(params, X, y, mask, max_iter: int, l2):
     loss = partial(_loss_fn, X=X, y=y, mask=mask, l2=l2)
-    optimizer = optax.lbfgs()
-    value_and_grad = optax.value_and_grad_from_state(loss)
+    # Backtracking (Armijo) line search instead of optax's default zoom:
+    # zoom's strong-Wolfe bracketing re-evaluates loss+grad many times
+    # per iteration, and on a 1M-row fit it was 94% of the wall-clock
+    # (18.9 s -> ~6 s on one v5e chip, identical accuracy, monotone
+    # convergence; measured in round 3). store_grad stays False: its
+    # value-fn transpose uses a Python-float cotangent that trips a
+    # dtype mismatch under x64 (optax linesearch.py:363), and the price
+    # is just one value_and_grad per accepted step.
+    optimizer = optax.lbfgs(
+        learning_rate=1.0,
+        linesearch=optax.scale_by_backtracking_linesearch(
+            max_backtracking_steps=15
+        ),
+    )
+    value_and_grad = jax.value_and_grad(loss)
 
     def step(carry, _):
         params, state = carry
-        value, grad = value_and_grad(params, state=state)
+        value, grad = value_and_grad(params)
         updates, state = optimizer.update(
             grad, state, params, value=value, grad=grad, value_fn=loss
         )
