@@ -1,0 +1,988 @@
+"""Typed columnar blocks: the store's cell engine.
+
+The reference delegates typed storage to MongoDB's BSON (reference:
+microservices/database_api_image/database.py:94-130 stores documents;
+Mongo owns the bytes). Round 3 kept dataset bodies as Python lists of
+boxed objects — ~60-100 bytes of interpreter overhead per cell — which
+capped the store at ~1M rows. This module is the fix: a :class:`Column`
+holds one field of a dataset block as a typed numpy buffer:
+
+- ``f8``  — float64 values
+- ``i8``  — int64 values
+- ``num`` — mixed int/float: float64 data + an int-mask so ``28``
+  round-trips as ``28`` and ``2.5`` as ``2.5`` (the dtype converter's
+  int-collapse contract, ops/dtype.py)
+- ``bool`` — bools (kept distinct from ``1``: Mongo's ``$group``
+  separates them, reference histogram.py:63-69)
+- ``str`` — Arrow-style UTF-8 byte buffer + int64 offsets (dataset
+  bodies arrive as raw strings at ingest — reference database.py:156-169
+  — so string cells must be unboxed too, not just numbers)
+- ``obj`` — Python-list fallback for mixed/irregular cells (document
+  overlays, probability vectors)
+
+``None`` (explicit null) and *missing* (a row that predates a
+later-added field — Mongo's absent-field state) are tracked in packed
+side masks, never as boxed sentinels in the data.
+
+Concurrency: columns are copy-on-write. ``snapshot()`` marks buffers
+shared; readers work outside the store lock while writers copy before
+the first in-place mutation. Appends never copy — they land beyond any
+snapshot's recorded ``size``.
+
+The same buffers serialize three ways with zero per-cell work: the
+binary HTTP wire (core/wire.py), base64 WAL records (crash recovery /
+replication), and numpy hand-off to the compute layer (core/table.py).
+"""
+
+from __future__ import annotations
+
+import base64
+from collections import Counter
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+__all__ = ["Column", "MISSING", "merge_kind"]
+
+
+class _Missing:
+    """Pad value for block rows that genuinely lack a field. Distinct
+    from ``None`` (an explicit null) so synthesized documents keep
+    Mongo's missing-field semantics ($exists, $ne on absent fields).
+    Never escapes the store: columnar reads map pads to ``None``."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<missing>"
+
+
+MISSING = _Missing()
+
+EMPTY = "empty"  # only pads so far; adopts the kind of the first data
+F8 = "f8"
+I8 = "i8"
+NUM = "num"
+BOOL = "bool"
+STR = "str"
+OBJ = "obj"
+
+_NUMERIC_KINDS = frozenset((F8, I8, NUM))
+_DTYPES = {F8: np.float64, I8: np.int64, NUM: np.float64, BOOL: np.bool_}
+
+
+def merge_kind(a: str, b: str) -> str:
+    if a == b:
+        return a
+    if a == EMPTY:
+        return b
+    if b == EMPTY:
+        return a
+    if a in _NUMERIC_KINDS and b in _NUMERIC_KINDS:
+        return NUM
+    return OBJ
+
+
+def _classify(values: Iterable) -> tuple[str, bool, bool]:
+    """(kind, has_none, has_missing) for raw Python values. The type-set
+    scan is a single C loop; per-value Python dispatch happens only for
+    genuinely mixed columns (→ obj, where it is unavoidable)."""
+    types = {type(v) for v in values}
+    has_none = type(None) in types
+    has_missing = _Missing in types
+    types.discard(type(None))
+    types.discard(_Missing)
+    kind = EMPTY
+    for t in types:
+        if t is bool or issubclass(t, np.bool_):
+            k = BOOL
+        elif issubclass(t, (int, np.integer)):
+            k = I8
+        elif issubclass(t, (float, np.floating)):
+            k = F8
+        elif issubclass(t, str):
+            k = STR
+        else:
+            k = OBJ
+        kind = merge_kind(kind, k)
+    return kind, has_none, has_missing
+
+
+def _object_array(values: list) -> np.ndarray:
+    out = np.empty(len(values), dtype=object)
+    out[:] = values
+    return out
+
+
+def _pack(mask: Optional[np.ndarray], size: int) -> Optional[bytes]:
+    if mask is None:
+        return None
+    return np.packbits(mask[:size]).tobytes()
+
+
+def _unpack(raw: Optional[bytes], size: int) -> Optional[np.ndarray]:
+    if raw is None:
+        return None
+    return np.unpackbits(
+        np.frombuffer(raw, dtype=np.uint8), count=size
+    ).astype(bool)
+
+
+def _b64(raw: Optional[bytes]) -> Optional[str]:
+    return None if raw is None else base64.b64encode(raw).decode("ascii")
+
+
+def _unb64(text: Optional[str]) -> Optional[bytes]:
+    return None if text is None else base64.b64decode(text)
+
+
+def _encode_strings(values: list) -> tuple[np.ndarray, np.ndarray]:
+    """Python strings → (uint8 byte buffer, int64 offsets). One joined
+    encode; char offsets are reused as byte offsets when the payload is
+    pure ASCII (the overwhelmingly common case)."""
+    n = len(values)
+    joined = "".join(values)
+    encoded = joined.encode("utf-8")
+    if len(encoded) == len(joined):  # ASCII: char lengths == byte lengths
+        lengths = np.fromiter(map(len, values), dtype=np.int64, count=n)
+    else:
+        lengths = np.fromiter(
+            (len(v.encode("utf-8")) for v in values), dtype=np.int64, count=n
+        )
+    offsets = np.empty(n + 1, dtype=np.int64)
+    offsets[0] = 0
+    np.cumsum(lengths, out=offsets[1:])
+    return np.frombuffer(encoded, dtype=np.uint8).copy(), offsets
+
+
+class Column:
+    """One field of a dataset block. See module docstring for kinds.
+
+    Internal geometry: ``data``/masks are capacity buffers; ``size`` is
+    the live prefix. For ``str``, ``data`` is the byte buffer (live
+    prefix ``offsets[size]``) and ``offsets`` has ``size + 1`` live
+    entries. ``edits`` (str only) overlays single-cell updates so a
+    point write into an Arrow layout is O(1), not an O(n) rebuild.
+    """
+
+    __slots__ = (
+        "kind",
+        "size",
+        "data",
+        "offsets",
+        "none",
+        "miss",
+        "intm",
+        "edits",
+        "_shared",
+    )
+
+    def __init__(self, kind: str = EMPTY):
+        self.kind = kind
+        self.size = 0
+        self.data: Any = [] if kind == OBJ else np.empty(
+            0, dtype=_DTYPES.get(kind, np.uint8)
+        )
+        self.offsets: Optional[np.ndarray] = (
+            np.zeros(1, dtype=np.int64) if kind == STR else None
+        )
+        self.none: Optional[np.ndarray] = None
+        self.miss: Optional[np.ndarray] = None
+        self.intm: Optional[np.ndarray] = None
+        self.edits: Optional[dict[int, Any]] = None
+        self._shared = False
+
+    # --- constructors ---------------------------------------------------------
+    @classmethod
+    def from_values(cls, values) -> "Column":
+        """Build from raw Python values (the JSON-wire / document path)."""
+        if isinstance(values, np.ndarray) and values.dtype != object:
+            return cls.from_numpy(values)
+        values = list(values)
+        kind, has_none, has_missing = _classify(values)
+        column = cls._build(values, kind, has_none, has_missing)
+        return column
+
+    @classmethod
+    def _build(
+        cls, values: list, kind: str, has_none: bool, has_missing: bool
+    ) -> "Column":
+        n = len(values)
+        column = cls(OBJ if kind == OBJ else kind)
+        column.size = n
+        if kind == OBJ:
+            column.data = list(values)
+            if has_missing:
+                column.miss = np.fromiter(
+                    (v is MISSING for v in values), dtype=bool, count=n
+                )
+                column.data = [None if v is MISSING else v for v in values]
+            return column
+        absent = None
+        if has_none or has_missing:
+            obj = _object_array(values)
+            if has_none:
+                column.none = np.fromiter(
+                    (v is None for v in values), dtype=bool, count=n
+                )
+            if has_missing:
+                column.miss = np.fromiter(
+                    (v is MISSING for v in values), dtype=bool, count=n
+                )
+            absent = (
+                column.none
+                if column.miss is None
+                else (
+                    column.miss
+                    if column.none is None
+                    else column.none | column.miss
+                )
+            )
+        if kind == EMPTY:
+            # only None/MISSING cells: keep an empty-kind column; data
+            # buffer is a placeholder until real values merge in
+            column.data = np.zeros(n, dtype=np.uint8)
+            return column
+        if kind == STR:
+            if absent is not None:
+                values = [
+                    "" if (v is None or v is MISSING) else v for v in values
+                ]
+            column.data, column.offsets = _encode_strings(values)
+            return column
+        try:
+            if absent is not None:
+                obj[absent] = False if kind == BOOL else 0
+                column.data = obj.astype(_DTYPES[kind])
+            else:
+                column.data = np.asarray(values, dtype=_DTYPES[kind])
+        except OverflowError:
+            # e.g. a Python int beyond int64 — keep the boxed fallback
+            return cls._build(values, OBJ, has_none, has_missing)
+        if kind == NUM:
+            column.intm = np.fromiter(
+                (type(v) is not float and not isinstance(v, np.floating)
+                 for v in values),
+                dtype=bool,
+                count=n,
+            )
+            if absent is not None:
+                column.intm[absent] = False
+        if kind == F8 and column.none is None:
+            nan = np.isnan(column.data)
+            if nan.any():
+                # NaN cells behave as nulls end to end (JSON has no NaN)
+                column.none = nan
+        elif kind == F8 and column.none is not None:
+            column.data[column.none] = np.nan
+        return column
+
+    @classmethod
+    def from_numpy(
+        cls, array: np.ndarray, none: Optional[np.ndarray] = None
+    ) -> "Column":
+        """Zero-conversion constructor from a typed numpy array — the
+        compute-layer hand-off. float64 NaNs read back as ``None``."""
+        array = np.ascontiguousarray(array)
+        if array.dtype == np.bool_:
+            column = cls(BOOL)
+        elif np.issubdtype(array.dtype, np.integer):
+            column = cls(I8)
+            array = array.astype(np.int64, copy=False)
+        elif np.issubdtype(array.dtype, np.floating):
+            column = cls(F8)
+            array = array.astype(np.float64, copy=False)
+            if none is None:
+                nan = np.isnan(array)
+                if nan.any():
+                    none = nan
+        elif array.dtype.kind == "U":
+            return cls.from_strings(array.tolist())
+        else:
+            return cls.from_values(array.tolist())
+        column.data = array
+        column.size = len(array)
+        if none is not None and none.any():
+            column.none = none.astype(bool).copy()
+            if column.kind == F8:
+                column.data = column.data.copy()
+                column.data[column.none] = np.nan
+        return column
+
+    @classmethod
+    def from_strings(
+        cls, values: list, none: Optional[np.ndarray] = None
+    ) -> "Column":
+        """All-string values (``none`` marks nulls) → Arrow layout."""
+        column = cls(STR)
+        column.size = len(values)
+        if none is not None and none.any():
+            column.none = none.astype(bool).copy()
+            values = [
+                "" if m else v for v, m in zip(values, column.none)
+            ]
+        column.data, column.offsets = _encode_strings(values)
+        return column
+
+    @classmethod
+    def from_nul_joined(cls, buffer: bytes, count: int) -> "Column":
+        """NUL-separated concatenation of ``count`` cells (the native CSV
+        parser's bulk export, native/csv_loader.cpp) → Arrow layout with
+        no intermediate Python strings."""
+        raw = np.frombuffer(buffer, dtype=np.uint8)
+        stops = np.flatnonzero(raw == 0)
+        if len(stops) != count:
+            # short buffer, or a cell containing a literal NUL — the
+            # separator protocol can't represent it; caller falls back
+            raise ValueError("NUL-joined buffer does not split into count cells")
+        column = cls(STR)
+        column.size = count
+        keep = np.ones(len(raw), dtype=bool)
+        keep[stops] = False
+        # offsets into the NUL-stripped buffer: each stop shifts later
+        # cells left by one
+        offsets = np.empty(count + 1, dtype=np.int64)
+        offsets[0] = 0
+        offsets[1:] = stops - np.arange(count)
+        column.data = raw[keep][: offsets[-1]].copy()
+        column.offsets = offsets
+        return column
+
+    @classmethod
+    def pads(cls, count: int) -> "Column":
+        column = cls(EMPTY)
+        column.size = count
+        column.data = np.zeros(count, dtype=np.uint8)
+        if count:
+            column.miss = np.ones(count, dtype=bool)
+        return column
+
+    # --- geometry / flags -----------------------------------------------------
+    def __len__(self) -> int:
+        return self.size
+
+    @property
+    def has_missing(self) -> bool:
+        return self.miss is not None and bool(self.miss[: self.size].any())
+
+    def is_missing(self, i: int) -> bool:
+        return self.miss is not None and bool(self.miss[i])
+
+    def _absent_mask(self) -> Optional[np.ndarray]:
+        if self.none is None and self.miss is None:
+            return None
+        if self.none is None:
+            return self.miss[: self.size]
+        if self.miss is None:
+            return self.none[: self.size]
+        return self.none[: self.size] | self.miss[: self.size]
+
+    # --- copy-on-write --------------------------------------------------------
+    def snapshot(self) -> "Column":
+        """A consistent read view sharing buffers; both sides copy
+        before their next in-place write. Appends by the live column
+        never disturb the snapshot (they land beyond its ``size``).
+        Must be called under the store lock."""
+        clone = Column.__new__(Column)
+        clone.kind = self.kind
+        clone.size = self.size
+        clone.data = self.data
+        clone.offsets = self.offsets
+        clone.none = self.none
+        clone.miss = self.miss
+        clone.intm = self.intm
+        clone.edits = dict(self.edits) if self.edits else None
+        clone._shared = True
+        self._shared = True
+        return clone
+
+    def _own(self) -> None:
+        """Copy shared buffers before an in-place mutation."""
+        if not self._shared:
+            return
+        if self.kind == OBJ:
+            self.data = list(self.data)
+        else:
+            self.data = self.data.copy()
+        if self.offsets is not None:
+            self.offsets = self.offsets.copy()
+        for slot in ("none", "miss", "intm"):
+            mask = getattr(self, slot)
+            if mask is not None:
+                setattr(self, slot, mask.copy())
+        self._shared = False
+
+    # --- mask helpers ---------------------------------------------------------
+    def _row_capacity(self) -> int:
+        if self.kind == OBJ:
+            return self.size
+        if self.kind == STR:
+            return max(len(self.offsets) - 1, self.size)
+        return max(len(self.data), self.size)
+
+    def _mask(self, slot: str) -> np.ndarray:
+        mask = getattr(self, slot)
+        capacity = self._row_capacity()
+        if mask is None:
+            mask = np.zeros(capacity, dtype=bool)
+            setattr(self, slot, mask)
+        elif len(mask) < capacity:
+            grown = np.zeros(capacity, dtype=bool)
+            grown[: len(mask)] = mask
+            mask = grown
+            setattr(self, slot, mask)
+        return mask
+
+    # --- appends (never copy shared buffers) ----------------------------------
+    def _reserve(self, extra: int) -> None:
+        """Grow ``data`` (non-str kinds) so ``size + extra`` fits."""
+        need = self.size + extra
+        if self.kind == OBJ:
+            return
+        if len(self.data) >= need:
+            return
+        capacity = max(need, 2 * len(self.data), 1024)
+        grown = np.empty(capacity, dtype=self.data.dtype)
+        grown[: self.size] = self.data[: self.size]
+        # NOTE: _shared stays set — masks/offsets may still be shared
+        # with a snapshot; _own() decides per-buffer at mutation time.
+        self.data = grown
+
+    def _append_masks(self, other: "Column", offset: int) -> None:
+        for slot in ("none", "miss"):
+            theirs = getattr(other, slot)
+            if theirs is not None and theirs[: other.size].any():
+                mask = self._mask(slot)
+                mask[offset : offset + other.size] = theirs[: other.size]
+            elif getattr(self, slot) is not None:
+                self._mask(slot)[offset : offset + other.size] = False
+
+    def append_column(self, other: "Column") -> "Column":
+        """Append ``other``'s cells; returns the (possibly re-kinded)
+        column — callers must re-assign. The store's one append path."""
+        if other.kind == EMPTY and self.kind not in (EMPTY, NUM):
+            other = other._as_kind(self.kind)
+        merged = merge_kind(self.kind, other.kind)
+        if merged != self.kind or (merged == NUM and other.kind != NUM):
+            return self._append_promoted(other, merged)
+        offset = self.size
+        if merged == OBJ:
+            if self._shared:
+                self.data = list(self.data[: self.size])
+                self._shared = False
+            self.data.extend(other.tolist(pad_as_none=True))
+            self.size += other.size
+            if other.miss is not None and other.miss[: other.size].any():
+                mask = self._mask("miss")
+                mask[offset : offset + other.size] = other.miss[: other.size]
+            return self
+        if merged == STR:
+            other = other._materialized()
+            my_bytes = int(self.offsets[self.size])
+            their_bytes = int(other.offsets[other.size])
+            if len(self.data) < my_bytes + their_bytes:
+                capacity = max(my_bytes + their_bytes, 2 * len(self.data), 4096)
+                grown = np.empty(capacity, dtype=np.uint8)
+                grown[:my_bytes] = self.data[:my_bytes]
+                self.data = grown
+            self.data[my_bytes : my_bytes + their_bytes] = other.data[
+                :their_bytes
+            ]
+            if len(self.offsets) < self.size + other.size + 1:
+                capacity = max(
+                    self.size + other.size + 1, 2 * len(self.offsets)
+                )
+                grown = np.empty(capacity, dtype=np.int64)
+                grown[: self.size + 1] = self.offsets[: self.size + 1]
+                self.offsets = grown
+            self.offsets[self.size + 1 : self.size + other.size + 1] = (
+                other.offsets[1 : other.size + 1] + my_bytes
+            )
+            self.size += other.size
+            self._append_masks(other, offset)
+            return self
+        if merged == EMPTY:
+            self._reserve(other.size)
+            self.size += other.size
+            self._append_masks(other, offset)
+            return self
+        self._reserve(other.size)
+        self.data[offset : offset + other.size] = other.data[: other.size]
+        self.size += other.size
+        self._append_masks(other, offset)
+        if merged == NUM:
+            intm = self._mask("intm")
+            if other.intm is not None:
+                intm[offset : offset + other.size] = other.intm[: other.size]
+            else:
+                intm[offset : offset + other.size] = False
+        return self
+
+    def _append_promoted(self, other: "Column", merged: str) -> "Column":
+        """Kind changes: rebuild self at the merged kind, then append."""
+        if merged == other.kind and self.kind == EMPTY:
+            # adopt the incoming kind, keeping the pad prefix
+            fresh = Column(other.kind if other.kind != EMPTY else EMPTY)
+            if other.kind == STR:
+                fresh.data = np.empty(0, dtype=np.uint8)
+                fresh.offsets = np.zeros(1, dtype=np.int64)
+            elif other.kind == OBJ:
+                fresh.data = []
+            else:
+                fresh.data = np.empty(0, dtype=_DTYPES.get(other.kind, np.uint8))
+            fresh = fresh.append_column(self._as_kind(other.kind))
+            return fresh.append_column(other)
+        if merged == NUM and self.kind in _NUMERIC_KINDS:
+            promoted = self._as_kind(NUM)
+            return promoted.append_column(other._as_kind(NUM))
+        if merged == OBJ:
+            promoted = self._as_kind(OBJ)
+            return promoted.append_column(other)
+        # e.g. empty incoming into typed self at same merged kind
+        return self.append_column(other._as_kind(self.kind))
+
+    def _as_kind(self, kind: str) -> "Column":
+        if kind == self.kind:
+            return self
+        if kind == NUM and self.kind in (I8, F8, EMPTY):
+            out = Column(NUM)
+            out.size = self.size
+            out.data = self.data[: self.size].astype(np.float64)
+            out.none = None if self.none is None else self.none[: self.size].copy()
+            out.miss = None if self.miss is None else self.miss[: self.size].copy()
+            intm = np.zeros(self.size, dtype=bool)
+            if self.kind == I8:
+                intm[:] = True
+                absent = out._absent_mask()
+                if absent is not None:
+                    intm[absent] = False
+            out.intm = intm
+            if out.none is not None:
+                out.data[out.none[: self.size]] = np.nan
+            return out
+        if kind == OBJ:
+            out = Column(OBJ)
+            out.size = self.size
+            out.data = self.tolist(pad_as_none=True)
+            out.miss = (
+                None if self.miss is None else self.miss[: self.size].copy()
+            )
+            return out
+        if self.kind == EMPTY:
+            out = Column(kind)
+            if kind == STR:
+                pads = [""] * self.size
+                out.size = self.size
+                out.data, out.offsets = _encode_strings(pads)
+            elif kind == OBJ:
+                out.size = self.size
+                out.data = [None] * self.size
+            else:
+                out.size = self.size
+                out.data = np.zeros(self.size, dtype=_DTYPES[kind])
+                if kind == NUM:
+                    out.intm = np.zeros(self.size, dtype=bool)
+            out.none = None if self.none is None else self.none[: self.size].copy()
+            out.miss = None if self.miss is None else self.miss[: self.size].copy()
+            return out
+        raise TypeError(f"cannot view {self.kind} column as {kind}")
+
+    def append_pads(self, count: int) -> "Column":
+        return self.append_column(Column.pads(count))
+
+    # --- point access ---------------------------------------------------------
+    def get(self, i: int):
+        """Python value at ``i`` (``MISSING`` for pads, ``None`` for
+        nulls)."""
+        if self.miss is not None and self.miss[i]:
+            return MISSING
+        if self.none is not None and self.none[i]:
+            return None
+        if self.edits is not None and i in self.edits:
+            return self.edits[i]
+        if self.kind == OBJ:
+            return self.data[i]
+        if self.kind == EMPTY:
+            return MISSING
+        if self.kind == STR:
+            start, stop = int(self.offsets[i]), int(self.offsets[i + 1])
+            return bytes(self.data[start:stop]).decode("utf-8")
+        value = self.data[i]
+        if self.kind == NUM:
+            return int(value) if self.intm is not None and self.intm[i] else float(value)
+        if self.kind == F8 and np.isnan(value):
+            return None
+        return value.item()
+
+    def set(self, i: int, value) -> "Column":
+        """Point write; returns the (possibly re-kinded) column."""
+        self._own()
+        if isinstance(value, float) and value != value:
+            value = None  # NaN behaves as null end to end (no JSON NaN)
+        kind, _, _ = _classify((value,))
+        if value is None or value is MISSING:
+            slot = "none" if value is None else "miss"
+            self._mask(slot)[i] = True
+            other = "miss" if value is None else "none"
+            if getattr(self, other) is not None:
+                self._mask(other)[i] = False
+            if self.kind == F8:
+                self.data[i] = np.nan
+            if self.edits is not None:
+                self.edits.pop(i, None)
+            return self
+        merged = merge_kind(self.kind, kind)
+        if merged != self.kind:
+            if merged == NUM and self.kind in _NUMERIC_KINDS:
+                promoted = self._as_kind(NUM)
+                return promoted.set(i, value)
+            if self.kind == EMPTY:
+                promoted = self._as_kind(kind)
+                return promoted.set(i, value)
+            promoted = self._as_kind(OBJ)
+            return promoted.set(i, value)
+        if self.none is not None:
+            self.none[i] = False
+        if self.miss is not None:
+            self.miss[i] = False
+        if self.kind == OBJ:
+            self.data[i] = value
+        elif self.kind == STR:
+            if self.edits is None:
+                self.edits = {}
+            self.edits[i] = value
+            if len(self.edits) > max(1024, self.size // 8):
+                rebuilt = Column.from_values(self.tolist(pad_as_none=False))
+                rebuilt.miss = self.miss
+                return rebuilt
+        else:
+            self.data[i] = value
+            if self.kind == NUM:
+                self._mask("intm")[i] = type(value) is not float and not isinstance(
+                    value, np.floating
+                )
+        return self
+
+    # --- bulk reads -----------------------------------------------------------
+    def _materialized(self) -> "Column":
+        """str kind with edits → a fresh edit-free Arrow column."""
+        if self.kind != STR or not self.edits:
+            return self
+        values = self._decode_all()
+        for i, value in self.edits.items():
+            values[i] = value
+        none = self.none[: self.size] if self.none is not None else None
+        fresh = Column.from_strings(values, none)
+        fresh.miss = self.miss[: self.size].copy() if self.miss is not None else None
+        return fresh
+
+    def _decode_all(self) -> list:
+        nbytes = int(self.offsets[self.size])
+        raw = bytes(self.data[:nbytes])
+        text = raw.decode("utf-8")
+        offsets = self.offsets
+        if len(text) == nbytes:  # ASCII: byte offsets index the str directly
+            return [
+                text[offsets[i] : offsets[i + 1]] for i in range(self.size)
+            ]
+        return [
+            raw[offsets[i] : offsets[i + 1]].decode("utf-8")
+            for i in range(self.size)
+        ]
+
+    def tolist(
+        self, start: int = 0, stop: Optional[int] = None, pad_as_none: bool = True
+    ) -> list:
+        """Python values in ``[start, stop)``; pads become ``None``
+        (default) or ``MISSING``."""
+        stop = self.size if stop is None else min(stop, self.size)
+        n = stop - start
+        if n <= 0:
+            return []
+        if self.kind == OBJ:
+            out = list(self.data[start:stop])
+        elif self.kind == EMPTY:
+            out = [None] * n
+        elif self.kind == STR:
+            if start == 0 and stop == self.size:
+                out = self._decode_all()
+            else:
+                base = int(self.offsets[start])
+                nbytes = int(self.offsets[stop]) - base
+                raw = bytes(self.data[base : base + nbytes])
+                text = raw.decode("utf-8")
+                offsets = self.offsets
+                if len(text) == nbytes:
+                    out = [
+                        text[offsets[i] - base : offsets[i + 1] - base]
+                        for i in range(start, stop)
+                    ]
+                else:
+                    out = [
+                        raw[offsets[i] - base : offsets[i + 1] - base].decode(
+                            "utf-8"
+                        )
+                        for i in range(start, stop)
+                    ]
+            if self.edits:
+                for i, value in self.edits.items():
+                    if start <= i < stop:
+                        out[i - start] = value
+        elif self.kind == NUM:
+            floats = self.data[start:stop].tolist()
+            if self.intm is None:
+                out = floats
+            else:
+                ints = self.intm[start:stop]
+                out = [
+                    int(v) if ints[i] else v for i, v in enumerate(floats)
+                ]
+        elif self.kind == F8:
+            out = self.data[start:stop].tolist()
+            if self.none is None:
+                nan = np.isnan(self.data[start:stop])
+                if nan.any():
+                    for i in np.flatnonzero(nan):
+                        out[i] = None
+        else:
+            out = self.data[start:stop].tolist()
+        if self.none is not None:
+            for i in np.flatnonzero(self.none[start:stop]):
+                out[i] = None
+        if self.miss is not None:
+            pad = None if pad_as_none else MISSING
+            for i in np.flatnonzero(self.miss[start:stop]):
+                out[i] = pad
+        return out
+
+    def slice(self, start: int, stop: int) -> "Column":
+        """Shared-buffer view of ``[start, stop)`` — O(1) for numeric
+        kinds. Used by the wire read path."""
+        stop = min(stop, self.size)
+        start = min(start, stop)
+        if self.kind == STR:
+            source = self._materialized()
+            out = Column(STR)
+            out.size = stop - start
+            base = int(source.offsets[start])
+            out.data = source.data[base : int(source.offsets[stop])]
+            out.offsets = source.offsets[start : stop + 1] - base
+        elif self.kind == OBJ:
+            out = Column(OBJ)
+            out.size = stop - start
+            out.data = self.data[start:stop]
+        else:
+            out = Column(self.kind)
+            out.size = stop - start
+            out.data = self.data[start:stop]
+        for slot in ("none", "miss", "intm"):
+            mask = getattr(self, slot)
+            if mask is not None:
+                setattr(out, slot, mask[start:stop])
+        out._shared = True
+        self._shared = True
+        return out
+
+    def to_float64(self, start: int = 0, stop: Optional[int] = None) -> np.ndarray:
+        """float64 view (nulls/pads → NaN) — the design-matrix hand-off.
+        Raises TypeError for non-numeric kinds."""
+        stop = self.size if stop is None else min(stop, self.size)
+        if self.kind in (F8, NUM):
+            out = self.data[start:stop].astype(np.float64, copy=True)
+        elif self.kind == I8:
+            out = self.data[start:stop].astype(np.float64)
+        elif self.kind == EMPTY:
+            return np.full(stop - start, np.nan)
+        else:
+            raise TypeError(f"{self.kind} column is not numeric")
+        absent = self._absent_mask()
+        if absent is not None:
+            out[absent[start:stop]] = np.nan
+        return out
+
+    def to_object(self, start: int = 0, stop: Optional[int] = None) -> np.ndarray:
+        """Object-array view with ``None`` for nulls AND pads — the
+        ColumnTable string-column hand-off."""
+        return _object_array(self.tolist(start, stop, pad_as_none=True))
+
+    # --- histogram ($group) fast path -----------------------------------------
+    def unique_counts(self) -> list[dict]:
+        """``[{_id, count}]`` groups over the live prefix — np.unique for
+        typed kinds, tagged Counter for obj (bool-vs-1 parity with the
+        row path, store._group_count)."""
+        absent = self._absent_mask()
+        null_count = int(absent.sum()) if absent is not None else 0
+        out: list[dict] = []
+        n = self.size
+        if self.kind == OBJ:
+            counts: dict = {}
+            for value in self.data[:n]:
+                key = (isinstance(value, bool), value)
+                counts[key] = counts.get(key, 0) + 1
+            out = [
+                {"_id": key[1], "count": count} for key, count in counts.items()
+            ]
+            if null_count:
+                # nulls already appear as None entries in data; pads were
+                # stored as None too — counts are consistent already
+                pass
+            return out
+        if self.kind == EMPTY:
+            return [{"_id": None, "count": n}] if n else []
+        if self.kind == STR:
+            source = self._materialized()
+            values = source._decode_all()
+            if absent is not None:
+                keep = ~absent
+                values = [v for v, k in zip(values, keep) if k]
+            counts = Counter(values)
+            out = [
+                {"_id": value, "count": count}
+                for value, count in counts.items()
+            ]
+        else:
+            data = self.data[:n]
+            if absent is not None:
+                data = data[~absent]
+            if self.kind == NUM:
+                intm = (
+                    self.intm[:n]
+                    if self.intm is not None
+                    else np.zeros(n, dtype=bool)
+                )
+                if absent is not None:
+                    intm = intm[~absent]
+                # ONE group per numeric value (2 and 2.0 merge, exactly
+                # like the dict/Counter row path and Mongo's $group);
+                # the key's int/float type follows the value's FIRST
+                # occurrence, matching Counter's first-seen-key rule
+                values, first, counts = np.unique(
+                    data, return_index=True, return_counts=True
+                )
+                for value, index, count in zip(values, first, counts):
+                    key = int(value) if intm[index] else float(value)
+                    out.append({"_id": key, "count": int(count)})
+            else:
+                if self.kind == F8:
+                    nan = np.isnan(data)
+                    nan_count = int(nan.sum())
+                    if nan_count:
+                        data = data[~nan]
+                        null_count += nan_count
+                values, counts = np.unique(data, return_counts=True)
+                out = [
+                    {"_id": value.item(), "count": int(count)}
+                    for value, count in zip(values, counts)
+                ]
+        if null_count:
+            out.append({"_id": None, "count": null_count})
+        return out
+
+    # --- serialization --------------------------------------------------------
+    def wire_parts(self) -> tuple[dict, list[bytes]]:
+        """(meta, buffers) for the binary HTTP frame (core/wire.py).
+        Buffer order: data, offsets, none, miss, intm — present iff the
+        corresponding meta flag says so."""
+        source = self._materialized()
+        n = source.size
+        meta: dict = {"kind": source.kind, "n": n}
+        buffers: list[bytes] = []
+        if source.kind == OBJ:
+            meta["values"] = source.tolist(pad_as_none=True)
+        elif source.kind == STR:
+            nbytes = int(source.offsets[n])
+            buffers.append(source.data[:nbytes].tobytes())
+            buffers.append(np.ascontiguousarray(source.offsets[: n + 1]).tobytes())
+            meta["data"] = True
+            meta["offsets"] = True
+        elif source.kind != EMPTY:
+            buffers.append(np.ascontiguousarray(source.data[:n]).tobytes())
+            meta["data"] = True
+        for slot in ("none", "miss", "intm"):
+            mask = getattr(source, slot)
+            # intm ships even when all-False: a NUM column without its
+            # int mask would deserialize structurally incomplete
+            if mask is not None and (
+                slot == "intm" or mask[:n].any()
+            ):
+                buffers.append(_pack(mask, n))
+                meta[slot] = True
+        return meta, buffers
+
+    @classmethod
+    def from_wire_parts(cls, meta: dict, buffers: list[bytes]) -> "Column":
+        kind = meta["kind"]
+        n = meta["n"]
+        column = cls(kind)
+        column.size = n
+        index = 0
+
+        def take() -> bytes:
+            nonlocal index
+            raw = buffers[index]
+            index += 1
+            return raw
+
+        if kind == OBJ:
+            column.data = list(meta["values"])
+        elif kind == STR:
+            column.data = np.frombuffer(take(), dtype=np.uint8).copy()
+            column.offsets = np.frombuffer(take(), dtype=np.int64).copy()
+        elif kind == EMPTY:
+            column.data = np.zeros(n, dtype=np.uint8)
+        else:
+            column.data = np.frombuffer(
+                take(), dtype=_DTYPES[kind]
+            ).copy()
+        for slot in ("none", "miss", "intm"):
+            if meta.get(slot):
+                setattr(column, slot, _unpack(take(), n))
+        if kind == NUM and column.intm is None:
+            # defensive: a NUM column always carries its int mask
+            column.intm = np.zeros(n, dtype=bool)
+        return column
+
+    def to_json_record(self) -> dict:
+        """Base64 form for WAL lines (crash recovery + replication)."""
+        meta, buffers = self.wire_parts()
+        record = {"k": meta["kind"], "n": meta["n"]}
+        if "values" in meta:
+            record["v"] = meta["values"]
+        index = 0
+        for key, flag in (
+            ("d", "data"),
+            ("o", "offsets"),
+            ("nm", "none"),
+            ("mm", "miss"),
+            ("im", "intm"),
+        ):
+            if meta.get(flag):
+                record[key] = _b64(buffers[index])
+                index += 1
+        return record
+
+    @classmethod
+    def from_json_record(cls, record: dict) -> "Column":
+        meta = {"kind": record["k"], "n": record["n"]}
+        if "v" in record:
+            meta["values"] = record["v"]
+        buffers: list[bytes] = []
+        for key, flag in (
+            ("d", "data"),
+            ("o", "offsets"),
+            ("nm", "none"),
+            ("mm", "miss"),
+            ("im", "intm"),
+        ):
+            if record.get(key) is not None:
+                meta[flag] = True
+                buffers.append(_unb64(record[key]))
+        return cls.from_wire_parts(meta, buffers)
+
+    def nbytes(self) -> int:
+        """Approximate live payload bytes (capacity excluded)."""
+        if self.kind == OBJ:
+            return self.size * 64  # boxed estimate
+        if self.kind == STR:
+            return int(self.offsets[self.size]) + 8 * (self.size + 1)
+        return int(self.data[: self.size].nbytes)

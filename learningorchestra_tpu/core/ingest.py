@@ -20,7 +20,7 @@ import io
 import tempfile
 from contextlib import ExitStack, closing
 from datetime import datetime, timezone
-from typing import Iterator, TextIO
+from typing import Iterator, Optional, TextIO
 
 import requests
 
@@ -139,7 +139,7 @@ def ingest_csv(
     store: DocumentStore,
     filename: str,
     url: str,
-    batch_size: int = BATCH_SIZE,
+    batch_size: Optional[int] = None,
 ) -> int:
     """Ingest the CSV at ``url`` into collection ``filename``,
     column-major.
@@ -159,11 +159,13 @@ def ingest_csv(
     second copy (the parse result) before the batched hand-off, so peak
     is ~2× the body — same order as the reference's Mongo working set.
     """
-    from learningorchestra_tpu.native.loader import read_csv_raw_columns
+    from learningorchestra_tpu.native.loader import read_csv_string_columns
 
     with ExitStack() as stack:
         path = _local_csv_path(url, stack)
-        parsed = read_csv_raw_columns(path)
+        # Native path: NUL-joined column buffers → Arrow string columns,
+        # no Python string objects between the parser and the store.
+        parsed = read_csv_string_columns(path)
         if parsed is None:
             parsed = _python_raw_columns(path)
     file_header, raw_columns = parsed
@@ -174,7 +176,7 @@ def ingest_csv(
     # per-row dict build did (database.py:156-169); a CSV column named
     # `_id` is discarded the same way the reference's row ids overwrote
     # it (database.py:161-168) — row ids are always 1..N.
-    columns: dict[str, list] = dict(zip(file_header, raw_columns))
+    columns = dict(zip(file_header, raw_columns))
     columns.pop(ROW_ID, None)
     num_rows = len(raw_columns[0]) if raw_columns else 0
     insert_columns_batched(store, filename, columns, batch_size=batch_size)

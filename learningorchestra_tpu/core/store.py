@@ -14,10 +14,19 @@ the framework:
 - :class:`InMemoryStore` — thread-safe in-process backend with an
   optional JSONL write-ahead log for durability. Used directly by tests
   and by the storage service (``services/storage.py``).
-- Columnar reads (:meth:`DocumentStore.read_columns`) are the data plane
-  between storage and the TPU: compute never does row-at-a-time RPCs the
-  way the reference does (reference:
+- Columnar reads (:meth:`DocumentStore.read_columns` /
+  :meth:`DocumentStore.read_column_arrays`) are the data plane between
+  storage and the TPU: compute never does row-at-a-time RPCs the way
+  the reference does (reference:
   microservices/model_builder_image/model_builder.py:237-247).
+
+Dataset bodies live in **typed columnar blocks** (core/columns.py):
+numpy buffers for numbers/bools, Arrow-style byte buffers for strings —
+~8 bytes/cell instead of the ~60-100 bytes a boxed Python object costs,
+which is what makes 10M+-row datasets fit where the reference leans on
+Mongo owning disk (reference: docker-compose.yml:335-340). A
+row-document overlay holds the ``_id: 0`` metadata document and any
+out-of-band inserts, preserving full document semantics.
 
 Queries are Mongo-style subset-equality matches, which is the full extent
 of what the reference services use.
@@ -30,7 +39,12 @@ import json
 import os
 import re
 import threading
-from typing import Any, Iterator, Optional
+from typing import Any, Iterator, Optional, Union
+
+import numpy as np
+
+from learningorchestra_tpu.core.columns import MISSING as _MISSING
+from learningorchestra_tpu.core.columns import Column
 
 ROW_ID = "_id"
 METADATA_ID = 0
@@ -46,6 +60,8 @@ METADATA_FIELDS = (
     "url",
     "parent_filename",
 )
+
+ColumnInput = Union[Column, list, np.ndarray]
 
 
 def parse_query(raw: Optional[str]) -> dict:
@@ -175,6 +191,15 @@ def matches(document: dict, query: dict) -> bool:
     return True
 
 
+def as_column(values: ColumnInput) -> Column:
+    """Normalize any accepted columnar input to a :class:`Column`."""
+    if isinstance(values, Column):
+        return values
+    if isinstance(values, np.ndarray):
+        return Column.from_numpy(values)
+    return Column.from_values(values)
+
+
 class DocumentStore:
     """Interface for collection-of-documents backends."""
 
@@ -207,26 +232,46 @@ class DocumentStore:
     def insert_columns(
         self,
         collection: str,
-        columns: dict[str, list],
+        columns: dict[str, ColumnInput],
         start_id: Optional[int] = None,
     ) -> None:
         """Bulk column-major append: rows ``start_id..start_id+n-1`` with
         ``{field: values[i]}``. The storage→compute data plane's write
         half — backends keep this columnar end to end so dataset bodies
-        never pay per-row Python dict costs. Default implementation
+        never pay per-row Python dict costs. Values may be plain lists,
+        numpy arrays, or :class:`Column` objects. Default implementation
         degrades to ``insert_many`` for row-oriented backends.
         """
+        columns = {name: as_column(values) for name, values in columns.items()}
         lengths = {len(values) for values in columns.values()}
         if len(lengths) > 1:
             raise ValueError("ragged columns")
         num_rows = lengths.pop() if lengths else 0
+        value_lists = {
+            name: column.tolist(pad_as_none=False)
+            for name, column in columns.items()
+        }
         documents = []
         for i in range(num_rows):
-            document = {name: values[i] for name, values in columns.items()}
+            document = {
+                name: values[i]
+                for name, values in value_lists.items()
+                if values[i] is not _MISSING
+            }
             if start_id is not None:
                 document[ROW_ID] = start_id + i
             documents.append(document)
         self.insert_many(collection, documents)
+
+    def insert_column_arrays(
+        self,
+        collection: str,
+        columns: dict[str, Column],
+        start_id: Optional[int] = None,
+    ) -> None:
+        """Typed-column append — the zero-conversion write half of the
+        data plane. Same semantics as :meth:`insert_columns`."""
+        self.insert_columns(collection, columns, start_id=start_id)
 
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         """Set ``new_values`` on the first document matching ``query``
@@ -250,7 +295,7 @@ class DocumentStore:
         self,
         collection: str,
         field: str,
-        values: list,
+        values: ColumnInput,
         start_id: int = 1,
     ) -> None:
         """Replace ``field`` for the contiguous rows ``start_id..`` with
@@ -258,6 +303,7 @@ class DocumentStore:
         uses (one bulk call per field; the reference issues 2 RPCs per
         row per field, reference data_type_handler.py:47-77). Default
         implementation degrades to ``set_field_values``."""
+        values = as_column(values).tolist(pad_as_none=False)
         self.set_field_values(
             collection,
             field,
@@ -324,6 +370,23 @@ class DocumentStore:
             field: [row.get(field) for row in rows] for field in fields
         }
 
+    def read_column_arrays(
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
+    ) -> dict[str, Column]:
+        """Typed-column read — the zero-conversion half of the data
+        plane. Same row semantics as :meth:`read_columns`. Default
+        implementation wraps the list read."""
+        return {
+            name: Column.from_values(values)
+            for name, values in self.read_columns(
+                collection, fields, start=start, limit=limit
+            ).items()
+        }
+
     # --- dataset metadata contract -------------------------------------------
     def metadata(self, collection: str) -> Optional[dict]:
         return self.find_one(collection, {ROW_ID: METADATA_ID})
@@ -350,68 +413,44 @@ def _is_int_id(doc_id: Any) -> bool:
     return isinstance(doc_id, int) and not isinstance(doc_id, bool)
 
 
-class _Missing:
-    """Pad value for block rows that genuinely lack a field (a field
-    added after the block was written). Distinct from ``None`` (an
-    explicit null) so synthesized documents keep Mongo's missing-field
-    semantics ($exists, $ne on absent fields, equality-with-None).
-    Never escapes the store: live WAL records log only caller-supplied
-    values (replay reproduces pads), compaction snapshots serialize pads
-    as null + an index mask (``compact``), and the columnar fast paths
-    map pads to ``None`` on the way out (``read_columns``,
-    ``aggregate``)."""
-
-    __slots__ = ()
-
-    def __repr__(self):
-        return "<missing>"
-
-
-_MISSING = _Missing()
-
-
 class _Collection:
     """One collection's storage: a contiguous column-major block for the
     dataset body plus a row-document overlay for everything else.
 
-    The block holds rows ``block_start..block_start+n-1`` as parallel
-    Python lists, one per field — the shape bulk ingest/projection write
-    and ``read_columns`` returns, so dataset bodies never materialise as
-    per-row dicts (the cost SURVEY §7.1's columnar-cache requirement
-    exists to avoid). The overlay holds the ``_id: 0`` metadata document
-    and any out-of-band inserts. Ids never overlap between the two.
+    The block holds rows ``block_start..block_start+n-1`` as typed
+    :class:`Column` buffers (core/columns.py), one per field — ~8
+    bytes/cell, zero boxed objects — the shape bulk ingest/projection
+    write and ``read_columns`` returns. The overlay holds the ``_id: 0``
+    metadata document and any out-of-band inserts. Ids never overlap
+    between the two.
     """
 
-    __slots__ = (
-        "block_fields",
-        "block_columns",
-        "block_start",
-        "rows",
-        "padded_fields",
-    )
+    __slots__ = ("block_fields", "block_columns", "block_start", "rows", "rev")
 
     def __init__(self):
         self.block_fields: list[str] = []
-        self.block_columns: dict[str, list] = {}
+        self.block_columns: dict[str, Column] = {}
         self.block_start = 1
         self.rows: dict[Any, dict] = {}
-        # fields whose columns may contain _MISSING pads
-        self.padded_fields: set[str] = set()
+        # Mutation counter: paged wire readers compare it across chunks
+        # to detect (and retry) a torn multi-request read.
+        self.rev = 0
 
     def snapshot(self) -> "_Collection":
-        """A consistent read view: column lists and overlay documents are
-        shallow-copied (O(rows) pointer copies — far cheaper than row
-        synthesis) so ``find`` can yield outside the store lock without
-        seeing concurrent mutations tear a document mid-iteration. Must
-        be called while holding the store lock."""
+        """A consistent read view: columns are copy-on-write snapshots
+        (O(1) per column), overlay documents shallow-copied — so
+        ``find`` can yield outside the store lock without seeing
+        concurrent mutations tear a document mid-iteration. Must be
+        called while holding the store lock."""
         clone = _Collection()
         clone.block_fields = list(self.block_fields)
         clone.block_columns = {
-            name: list(column) for name, column in self.block_columns.items()
+            name: column.snapshot()
+            for name, column in self.block_columns.items()
         }
         clone.block_start = self.block_start
         clone.rows = {doc_id: dict(row) for doc_id, row in self.rows.items()}
-        clone.padded_fields = set(self.padded_fields)
+        clone.rev = self.rev
         return clone
 
     # --- block geometry -------------------------------------------------------
@@ -444,7 +483,7 @@ class _Collection:
         i = doc_id - self.block_start
         document = {}
         for name in self.block_fields:
-            value = self.block_columns[name][i]
+            value = self.block_columns[name].get(i)
             if value is not _MISSING:
                 document[name] = value
         document[ROW_ID] = doc_id
@@ -473,16 +512,14 @@ class _Collection:
         return [i for i in self.rows if i != METADATA_ID]
 
     # --- block mutation -------------------------------------------------------
-    def ensure_block_field(self, field: str) -> list:
+    def ensure_block_field(self, field: str) -> Column:
         if field == ROW_ID:
             raise KeyError("_id is not a block field")
         column = self.block_columns.get(field)
         if column is None:
-            column = [_MISSING] * self.block_rows
+            column = Column.pads(self.block_rows)
             self.block_columns[field] = column
             self.block_fields.append(field)
-            if self.block_rows:
-                self.padded_fields.add(field)
         return column
 
     def set_block_values(self, doc_id: int, new_values: dict) -> None:
@@ -490,12 +527,13 @@ class _Collection:
         for field, value in new_values.items():
             if field == ROW_ID:
                 continue
-            self.ensure_block_field(field)[i] = value
+            column = self.ensure_block_field(field)
+            self.block_columns[field] = column.set(i, value)
 
     def append_columns(
-        self, fields: list[str], columns: dict[str, list], start_id: int
+        self, columns: dict[str, Column], start_id: int
     ) -> None:
-        num_new = len(columns[fields[0]]) if fields else 0
+        num_new = len(next(iter(columns.values()))) if columns else 0
         if self.block_columns:
             if start_id != self.block_stop:
                 raise ValueError(
@@ -507,15 +545,15 @@ class _Collection:
         for doc_id in range(start_id, start_id + num_new):
             if doc_id in self.rows:
                 raise KeyError(f"duplicate _id {doc_id!r}")
-        for field in fields:
+        for field in columns:
             self.ensure_block_field(field)
-        pad = [_MISSING] * num_new
-        for field, column in self.block_columns.items():
-            if field in columns:
-                column.extend(columns[field])
+        for field in list(self.block_columns):
+            column = self.block_columns[field]
+            incoming = columns.get(field)
+            if incoming is not None:
+                self.block_columns[field] = column.append_column(incoming)
             else:
-                column.extend(pad)
-                self.padded_fields.add(field)
+                self.block_columns[field] = column.append_pads(num_new)
 
 
 class InMemoryStore(DocumentStore):
@@ -524,6 +562,8 @@ class InMemoryStore(DocumentStore):
     Durability model: every mutation appends one JSON line to
     ``<data_dir>/wal.jsonl``; opening a store with the same ``data_dir``
     replays the log. ``compact()`` rewrites the log as a snapshot.
+    Columnar payloads ride the WAL as base64-encoded typed buffers
+    (``Column.to_json_record``), not per-value JSON.
     """
 
     def __init__(self, data_dir: Optional[str] = None, replicate: bool = False):
@@ -546,6 +586,9 @@ class InMemoryStore(DocumentStore):
             self._wal = open(wal_path, "a", encoding="utf-8")
 
     # --- WAL ------------------------------------------------------------------
+    def _wal_enabled(self) -> bool:
+        return self._wal is not None or self._wal_buffer is not None
+
     def _log(self, record: dict) -> None:
         if self._wal is None and self._wal_buffer is None:
             return
@@ -575,10 +618,21 @@ class InMemoryStore(DocumentStore):
         elif op == "insert_many":
             for document in record["d"]:
                 self._apply_insert(record["c"], document)
-        elif op == "insert_cols":
+        elif op == "insert_cols_b":
             self._apply_insert_columns(
-                record["c"], record["d"], record["s"],
-                missing=record.get("m"),
+                record["c"],
+                {
+                    field: Column.from_json_record(col)
+                    for field, col in record["cols"].items()
+                },
+                record["s"],
+            )
+        elif op == "insert_cols":
+            # legacy list form (pre-typed-block WALs)
+            self._apply_insert_columns(
+                record["c"],
+                _legacy_columns(record["d"], record.get("m")),
+                record["s"],
             )
         elif op == "update":
             self._apply_update(record["c"], record["q"], record["v"])
@@ -586,9 +640,19 @@ class InMemoryStore(DocumentStore):
             # Logged as [id, value] pairs so JSON preserves the
             # id's type (dict keys would stringify int ids).
             self._apply_set_field(record["c"], record["f"], dict(record["d"]))
+        elif op == "set_col_b":
+            self._apply_set_column(
+                record["c"],
+                record["f"],
+                Column.from_json_record(record["col"]),
+                record["s"],
+            )
         elif op == "set_col":
             self._apply_set_column(
-                record["c"], record["f"], record["d"], record["s"]
+                record["c"],
+                record["f"],
+                Column.from_values(record["d"]),
+                record["s"],
             )
         elif op == "create":
             self._collections.setdefault(record["c"], _Collection())
@@ -679,10 +743,9 @@ class InMemoryStore(DocumentStore):
 
         Crash-safe: the snapshot is written to a temp file and
         ``os.replace``d over ``wal.jsonl``, so a failed compaction leaves
-        the old log intact. ``_Missing`` pads (rows that never got a
-        later-added field) are serialized explicitly as null + a
-        missing-index mask (the ``"m"`` key) — they can't round-trip as
-        raw values because JSON has no missing/null distinction.
+        the old log intact. Typed blocks serialize as base64 buffer
+        records — null masks and missing-pad masks ride along explicitly
+        (JSON has no missing/null distinction to round-trip).
         """
         with self._lock:
             if self._wal is None and self._wal_buffer is None:
@@ -740,27 +803,15 @@ class InMemoryStore(DocumentStore):
         for name, col in self._collections.items():
             yield {"op": "create", "c": name}
             if col.block_columns:
-                record = {
-                    "op": "insert_cols",
+                yield {
+                    "op": "insert_cols_b",
                     "c": name,
                     "s": col.block_start,
-                    "d": {},
+                    "cols": {
+                        field: column.to_json_record()
+                        for field, column in col.block_columns.items()
+                    },
                 }
-                missing: dict[str, list[int]] = {}
-                for field, column in col.block_columns.items():
-                    if field in col.padded_fields:
-                        indices = [
-                            i for i, v in enumerate(column) if v is _MISSING
-                        ]
-                        if indices:
-                            missing[field] = indices
-                            column = [
-                                None if v is _MISSING else v for v in column
-                            ]
-                    record["d"][field] = column
-                if missing:
-                    record["m"] = missing
-                yield record
             if col.rows:
                 yield {"op": "insert_many", "c": name, "d": list(col.rows.values())}
 
@@ -775,30 +826,23 @@ class InMemoryStore(DocumentStore):
         if col.has_id(doc_id):
             raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
         col.rows[doc_id] = dict(document)
+        col.rev += 1
 
     def _apply_insert_columns(
         self,
         collection: str,
-        columns: dict[str, list],
+        columns: dict[str, Column],
         start_id: int,
-        missing: Optional[dict] = None,
     ) -> None:
         col = self._collections.setdefault(collection, _Collection())
-        col.append_columns(list(columns.keys()), columns, start_id)
-        if missing:  # snapshot replay: restore _Missing pads (see compact)
-            offset = start_id - col.block_start
-            for field, indices in missing.items():
-                column = col.block_columns.get(field)
-                if column is None:
-                    continue
-                for i in indices:
-                    column[offset + i] = _MISSING
-                col.padded_fields.add(field)
+        col.append_columns(columns, start_id)
+        col.rev += 1
 
     def _apply_update(self, collection: str, query: dict, new_values: dict) -> None:
         col = self._collections.get(collection)
         if col is None:
             return
+        col.rev += 1
         if list(query.keys()) == [ROW_ID] and (
             _is_int_id(query[ROW_ID]) or isinstance(query[ROW_ID], str)
         ):  # the dominant fast path: literal-id lookup
@@ -822,34 +866,43 @@ class InMemoryStore(DocumentStore):
         col = self._collections.get(collection)
         if col is None:
             return
-        block_column = None
+        col.rev += 1
+        ensured = False
         for doc_id, value in values_by_id.items():
             if col.in_block(doc_id):
-                if block_column is None:
-                    block_column = col.ensure_block_field(field)
-                block_column[doc_id - col.block_start] = value
+                if not ensured:
+                    col.ensure_block_field(field)
+                    ensured = True
+                column = col.block_columns[field]
+                col.block_columns[field] = column.set(
+                    doc_id - col.block_start, value
+                )
             elif doc_id in col.rows:
                 col.rows[doc_id][field] = value
 
     def _apply_set_column(
-        self, collection: str, field: str, values: list, start_id: int
+        self, collection: str, field: str, values: Column, start_id: int
     ) -> None:
         col = self._collections.get(collection)
         if col is None:
             return
-        # Whole-block replace: one list assignment, no per-id work.
+        col.rev += 1
+        # Whole-block replace: one column swap, no per-id work.
         if (
             col.block_columns
             and start_id == col.block_start
             and len(values) == col.block_rows
         ):
             col.ensure_block_field(field)
-            col.block_columns[field] = list(values)
+            col.block_columns[field] = values
             return
         self._apply_set_field(
             collection,
             field,
-            {start_id + i: value for i, value in enumerate(values)},
+            {
+                start_id + i: value
+                for i, value in enumerate(values.tolist(pad_as_none=False))
+            },
         )
 
     # --- DocumentStore implementation -----------------------------------------
@@ -896,23 +949,41 @@ class InMemoryStore(DocumentStore):
     def insert_columns(
         self,
         collection: str,
-        columns: dict[str, list],
+        columns: dict[str, ColumnInput],
         start_id: Optional[int] = None,
     ) -> None:
-        lengths = {len(values) for values in columns.values()}
-        if len(lengths) > 1:
-            raise ValueError("ragged columns")
         if ROW_ID in columns:
             raise ValueError("_id is implicit in insert_columns (start_id..)")
+        typed = {name: as_column(values) for name, values in columns.items()}
+        lengths = {len(values) for values in typed.values()}
+        if len(lengths) > 1:
+            raise ValueError("ragged columns")
         with self._lock:
             col = self._collections.get(collection) or _Collection()
             if start_id is None:
                 start_id = col.block_stop if col.block_columns else 1
             # append_columns validates contiguity + overlay collisions
-            self._apply_insert_columns(collection, columns, start_id)
-            self._log(
-                {"op": "insert_cols", "c": collection, "s": start_id, "d": columns}
-            )
+            self._apply_insert_columns(collection, typed, start_id)
+            if self._wal_enabled():  # base64 encode only when a log exists
+                self._log(
+                    {
+                        "op": "insert_cols_b",
+                        "c": collection,
+                        "s": start_id,
+                        "cols": {
+                            field: column.to_json_record()
+                            for field, column in typed.items()
+                        },
+                    }
+                )
+
+    def insert_column_arrays(
+        self,
+        collection: str,
+        columns: dict[str, Column],
+        start_id: Optional[int] = None,
+    ) -> None:
+        self.insert_columns(collection, columns, start_id=start_id)
 
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         with self._lock:
@@ -937,20 +1008,22 @@ class InMemoryStore(DocumentStore):
         self,
         collection: str,
         field: str,
-        values: list,
+        values: ColumnInput,
         start_id: int = 1,
     ) -> None:
+        typed = as_column(values)
         with self._lock:
-            self._apply_set_column(collection, field, values, start_id)
-            self._log(
-                {
-                    "op": "set_col",
-                    "c": collection,
-                    "f": field,
-                    "s": start_id,
-                    "d": values,
-                }
-            )
+            self._apply_set_column(collection, field, typed, start_id)
+            if self._wal_enabled():
+                self._log(
+                    {
+                        "op": "set_col_b",
+                        "c": collection,
+                        "f": field,
+                        "s": start_id,
+                        "col": typed.to_json_record(),
+                    }
+                )
 
     def find(
         self,
@@ -964,9 +1037,22 @@ class InMemoryStore(DocumentStore):
             col = self._collections.get(collection)
             if col is None:
                 return iter(())
-            # Snapshot under the lock (cheap: copied maps, shared column/
-            # document refs), synthesize row dicts outside it — an
-            # unlimited find over a large block no longer holds the store
+            # Literal-id point lookup (the poll loop's shape: metadata
+            # reads every few seconds) — synthesize ONE document under
+            # the lock, no snapshot of the whole collection.
+            if (
+                list(query.keys()) == [ROW_ID]
+                and not isinstance(query[ROW_ID], dict)
+                and skip == 0
+            ):
+                doc_id = query[ROW_ID]
+                if col.has_id(doc_id):
+                    document = col.document(doc_id)
+                    return iter(() if limit == 0 else (document,))
+                return iter(())
+            # Snapshot under the lock (cheap: copy-on-write columns,
+            # copied overlay dicts), synthesize row dicts outside it —
+            # an unlimited find over a large block never holds the store
             # lock for O(rows) dict building.
             view = col.snapshot()
 
@@ -994,10 +1080,17 @@ class InMemoryStore(DocumentStore):
                 return 0
             return col.block_rows + len(col.rows)
 
+    def collection_rev(self, collection: str) -> int:
+        """Mutation counter for torn-read detection on paged wire reads."""
+        with self._lock:
+            col = self._collections.get(collection)
+            return -1 if col is None else col.rev
+
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
         # Columnar fast path: the histogram's value-count $group runs
-        # straight over the block column — no row synthesis (the on-store
-        # analogue of the reference's Mongo-server $group pushdown).
+        # straight over the typed block column — np.unique / Counter in
+        # C, no row synthesis (the on-store analogue of the reference's
+        # Mongo-server $group pushdown, histogram.py:63-69).
         with self._lock:
             col = self._collections.get(collection)
             if (
@@ -1008,35 +1101,26 @@ class InMemoryStore(DocumentStore):
             ):
                 key_expr = pipeline[0]["$group"].get("_id")
                 if isinstance(key_expr, str) and key_expr.startswith("$"):
-                    from collections import Counter
-
                     field = key_expr[1:]
                     if field == ROW_ID:
-                        values = list(range(col.block_start, col.block_stop))
-                    else:
-                        values = col.block_columns.get(field)
-                        if values is None:
-                            values = [None] * col.block_rows
-                        elif field in col.padded_fields:
-                            # parity with the row path's document.get(field)
-                            values = [
-                                None if v is _MISSING else v for v in values
-                            ]
-                    if any(type(value) is bool for value in values):
-                        # True hashes equal to 1; Counter would merge
-                        # the groups. Tag keys like _group_count does.
-                        counts: dict = {}
-                        for value in values:
-                            key = (isinstance(value, bool), value)
-                            counts[key] = counts.get(key, 0) + 1
                         return [
-                            {"_id": key[1], "count": count}
-                            for key, count in counts.items()
+                            {"_id": doc_id, "count": 1}
+                            for doc_id in range(col.block_start, col.block_stop)
                         ]
-                    return [
-                        {"_id": key, "count": count}
-                        for key, count in Counter(values).items()
-                    ]
+                    column = col.block_columns.get(field)
+                    if column is None:
+                        return (
+                            [{"_id": None, "count": col.block_rows}]
+                            if col.block_rows
+                            else []
+                        )
+                    column = column.snapshot()
+                else:
+                    column = None
+            else:
+                column = None
+        if column is not None:
+            return column.unique_counts()
         results: list[dict] = list(self.find(collection))
         for stage in pipeline:
             if "$match" in stage:
@@ -1058,37 +1142,68 @@ class InMemoryStore(DocumentStore):
         start: int = 0,
         limit: Optional[int] = None,
     ) -> dict[str, list]:
+        arrays = self.read_column_arrays(collection, fields, start, limit)
+        return {name: column.tolist() for name, column in arrays.items()}
+
+    def read_column_arrays(
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
+    ) -> dict[str, Column]:
+        return self.read_column_arrays_rev(collection, fields, start, limit)[0]
+
+    def read_column_arrays_rev(
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
+    ) -> tuple[dict[str, Column], int]:
+        """``(columns, rev)`` with the rev captured under the SAME lock
+        acquisition as the read — a write can never land between the
+        data and its reported rev, so equal revs across paged chunks
+        prove no tear."""
         with self._lock:
             col = self._collections.get(collection)
             if col is None:
-                return {field: [] for field in fields} if fields else {}
+                return (
+                    {field: Column() for field in fields} if fields else {}
+                ), -1
+            rev = col.rev
             if not col.overlay_data_ids():
-                # Pure-block dataset: hand back column slices directly —
-                # a paged read costs O(chunk), not O(rows).
+                # Pure-block dataset: hand back copy-on-write column
+                # slices directly — a paged read costs O(chunk) for
+                # strings and O(1) for numeric kinds, never O(rows).
                 stop = (
                     col.block_rows
                     if limit is None
                     else min(start + limit, col.block_rows)
                 )
                 names = fields if fields is not None else list(col.block_fields)
-                out: dict[str, list] = {}
+                out: dict[str, Column] = {}
                 for name in names:
                     if name == ROW_ID:
-                        out[name] = list(
-                            range(col.block_start + start, col.block_start + stop)
+                        out[name] = Column.from_numpy(
+                            np.arange(
+                                col.block_start + start,
+                                col.block_start + stop,
+                                dtype=np.int64,
+                            )
                         )
                     elif name in col.block_columns:
-                        column = col.block_columns[name][start:stop]
-                        if name in col.padded_fields:
-                            # parity with row.get(field): pads read as None
-                            out[name] = [
-                                None if v is _MISSING else v for v in column
-                            ]
-                        else:
-                            out[name] = list(column)
+                        out[name] = col.block_columns[name].slice(start, stop)
                     else:
-                        out[name] = [None] * max(stop - start, 0)
-                return out
+                        pads = Column(
+                            "empty"
+                        )
+                        pads.size = max(stop - start, 0)
+                        pads.data = np.zeros(pads.size, dtype=np.uint8)
+                        if pads.size:
+                            pads.none = np.ones(pads.size, dtype=bool)
+                        out[name] = pads
+                return out, rev
             # Mixed block + overlay rows: page over the merged id order,
             # synthesizing row dicts ONLY for the requested slice — a
             # paged read costs O(ids + chunk), never O(rows) dict
@@ -1109,12 +1224,30 @@ class InMemoryStore(DocumentStore):
                             names.append(key)
             fields = names
         stop_index = None if limit is None else start + limit
-        out = {field: [] for field in fields}
+        lists: dict[str, list] = {field: [] for field in fields}
         for doc_id in data_ids[start:stop_index]:
             document = view.document(doc_id)
             for field in fields:
-                out[field].append(document.get(field))
-        return out
+                lists[field].append(document.get(field))
+        return {
+            field: Column.from_values(values) for field, values in lists.items()
+        }, rev
+
+
+def _legacy_columns(
+    raw: dict[str, list], missing: Optional[dict]
+) -> dict[str, Column]:
+    """Decode a legacy list-form ``insert_cols`` WAL record (with its
+    optional missing-index mask) into typed columns."""
+    out: dict[str, Column] = {}
+    for field, values in raw.items():
+        indices = set((missing or {}).get(field, ()))
+        if indices:
+            values = [
+                _MISSING if i in indices else v for i, v in enumerate(values)
+            ]
+        out[field] = Column.from_values(values)
+    return out
 
 
 _GLOBAL_STORE: Optional[InMemoryStore] = None

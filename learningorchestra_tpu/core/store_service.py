@@ -29,6 +29,19 @@ internal contract, versioned by the framework):
 - ``GET  /wal?epoch&offset&limit``              → WAL feed for followers
 - ``POST /promote``                             → follower becomes writable
 
+Binary columnar verbs (typed buffers, ``core/wire.py`` framing — the
+data plane large datasets actually ride; the JSON forms above remain
+for small bodies and debuggability):
+
+- ``POST /c/<name>/read_columns_bin``  JSON ``{"fields","start","limit"}``
+  → ``application/x-lo-columns`` frame; the frame's ``extra`` carries
+  ``rev`` (collection mutation counter) so paged readers can detect a
+  write landing between chunks and retry instead of returning a torn
+  result.
+- ``POST /c/<name>/insert_columns_bin``  frame with ``extra.start_id``
+- ``POST /c/<name>/set_column_bin``      frame with ``extra.field`` /
+  ``extra.start_id``
+
 Error mapping: ``KeyError`` (duplicate ids/collections) → 409;
 ``UnsupportedQueryError`` → 400 with ``kind: unsupported_query``; other
 ``ValueError`` → 400; mutation on a follower → 503. :class:`RemoteStore`
@@ -55,12 +68,18 @@ from typing import Iterator, Optional
 
 import requests
 
+from learningorchestra_tpu.core.columns import Column
 from learningorchestra_tpu.core.store import (
     DocumentStore,
     InMemoryStore,
     UnsupportedQueryError,
 )
-from learningorchestra_tpu.utils.web import ServerThread, WebApp
+from learningorchestra_tpu.core.wire import (
+    CONTENT_TYPE as BIN_CONTENT_TYPE,
+    decode_frame,
+    encode_frame,
+)
+from learningorchestra_tpu.utils.web import Response, ServerThread, WebApp
 
 DEFAULT_STORE_PORT = 27027
 
@@ -100,7 +119,11 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
 
     @app.route("/health", methods=("GET",))
     def health(request):
-        return {"ok": True, "writable": role.get("writable", True)}, 200
+        return {
+            "ok": True,
+            "writable": role.get("writable", True),
+            "columns_wire": "bin1",
+        }, 200
 
     @app.route("/wal", methods=("GET",))
     def wal(request):
@@ -220,6 +243,51 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
         )
         return {"columns": columns}, 200
 
+    @app.route("/c/<name>/read_columns_bin", methods=("POST",))
+    @guarded
+    def read_columns_bin(request, name):
+        body = request.get_json()
+        if hasattr(store, "read_column_arrays_rev"):
+            # rev captured under the same lock as the read — equal revs
+            # across chunks prove no write interleaved
+            columns, rev = store.read_column_arrays_rev(
+                name,
+                body.get("fields"),
+                start=body.get("start", 0),
+                limit=body.get("limit"),
+            )
+        else:
+            columns = store.read_column_arrays(
+                name,
+                body.get("fields"),
+                start=body.get("start", 0),
+                limit=body.get("limit"),
+            )
+            rev = -1
+        frame = encode_frame(columns, extra={"rev": rev})
+        return Response(frame, mimetype=BIN_CONTENT_TYPE, status=200)
+
+    @app.route("/c/<name>/insert_columns_bin", methods=("POST",))
+    @guarded
+    @mutating
+    def insert_columns_bin(request, name):
+        columns, extra = decode_frame(request.get_data())
+        store.insert_column_arrays(
+            name, columns, start_id=extra.get("start_id")
+        )
+        return {}, 200
+
+    @app.route("/c/<name>/set_column_bin", methods=("POST",))
+    @guarded
+    @mutating
+    def set_column_bin(request, name):
+        columns, extra = decode_frame(request.get_data())
+        field = extra["field"]
+        store.set_column(
+            name, field, columns[field], start_id=extra.get("start_id", 1)
+        )
+        return {}, 200
+
     @app.route("/c/<name>/aggregate", methods=("POST",))
     @guarded
     def aggregate(request, name):
@@ -258,6 +326,11 @@ class RemoteStore(DocumentStore):
         self.wire_rows = max(
             1, wire_rows or int(os.environ.get("LO_WIRE_ROWS", "100000"))
         )
+        # Rows per binary-frame chunk: typed buffers are ~10× denser
+        # than JSON, so the binary plane pages in much larger strides.
+        self.wire_rows_bin = max(
+            1, int(os.environ.get("LO_WIRE_ROWS_BIN", "2000000"))
+        )
         self._local = threading.local()
 
     # one session per thread: requests.Session pools connections but is
@@ -294,6 +367,27 @@ class RemoteStore(DocumentStore):
         self._raise_for(response)
         return response.json()
 
+    def _post_frame(self, path: str, frame: bytes) -> dict:
+        response = self._session.post(
+            f"{self.base_url}{path}",
+            data=frame,
+            headers={"Content-Type": BIN_CONTENT_TYPE},
+            timeout=self.timeout,
+        )
+        self._raise_for(response)
+        return response.json()
+
+    def _post_for_frame(self, path: str, body: dict):
+        """POST JSON, receive a binary columnar frame."""
+        response = self._session.post(
+            f"{self.base_url}{path}",
+            data=json.dumps(body),
+            headers={"Content-Type": "application/json"},
+            timeout=self.timeout,
+        )
+        self._raise_for(response)
+        return decode_frame(response.content)
+
     def _get(self, path: str) -> dict:
         response = self._session.get(f"{self.base_url}{path}", timeout=self.timeout)
         self._raise_for(response)
@@ -323,13 +417,48 @@ class RemoteStore(DocumentStore):
     def insert_columns(
         self,
         collection: str,
-        columns: dict[str, list],
+        columns: dict,
         start_id: Optional[int] = None,
     ) -> None:
-        self._post(
-            f"/c/{collection}/insert_columns",
-            {"columns": columns, "start_id": start_id},
+        from learningorchestra_tpu.core.store import as_column
+
+        self.insert_column_arrays(
+            collection,
+            {name: as_column(values) for name, values in columns.items()},
+            start_id=start_id,
         )
+
+    def insert_column_arrays(
+        self,
+        collection: str,
+        columns: dict[str, Column],
+        start_id: Optional[int] = None,
+    ) -> None:
+        """Typed columns ride the binary wire, paged in
+        ``wire_rows_bin`` strides so one call never builds an unbounded
+        frame. Client-side ragged validation keeps the error local."""
+        lengths = {len(column) for column in columns.values()}
+        if len(lengths) > 1:
+            raise ValueError("ragged columns")
+        num_rows = lengths.pop() if lengths else 0
+        if not columns:
+            return
+        stride = self.wire_rows_bin
+        for offset in range(0, max(num_rows, 1), stride):
+            stop = min(offset + stride, num_rows)
+            chunk = {
+                name: column.slice(offset, stop)
+                for name, column in columns.items()
+            }
+            extra = {
+                "start_id": None if start_id is None else start_id + offset
+            }
+            self._post_frame(
+                f"/c/{collection}/insert_columns_bin",
+                encode_frame(chunk, extra=extra),
+            )
+            if stop >= num_rows:
+                break
 
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         self._post(
@@ -346,12 +475,25 @@ class RemoteStore(DocumentStore):
         )
 
     def set_column(
-        self, collection: str, field: str, values: list, start_id: int = 1
+        self, collection: str, field: str, values, start_id: int = 1
     ) -> None:
-        self._post(
-            f"/c/{collection}/set_column",
-            {"field": field, "values": values, "start_id": start_id},
-        )
+        from learningorchestra_tpu.core.store import as_column
+
+        column = as_column(values)
+        # Page large replaces in strides; each stride is itself a
+        # contiguous set_column at the shifted start_id.
+        stride = self.wire_rows_bin
+        for offset in range(0, max(len(column), 1), stride):
+            stop = min(offset + stride, len(column))
+            self._post_frame(
+                f"/c/{collection}/set_column_bin",
+                encode_frame(
+                    {field: column.slice(offset, stop)},
+                    extra={"field": field, "start_id": start_id + offset},
+                ),
+            )
+            if stop >= len(column):
+                break
 
     def find(
         self,
@@ -405,6 +547,84 @@ class RemoteStore(DocumentStore):
             if chunk_rows < chunk_limit or chunk_rows == 0:
                 break
         return out
+
+    def read_column_arrays(
+        self,
+        collection: str,
+        fields: Optional[list[str]] = None,
+        start: int = 0,
+        limit: Optional[int] = None,
+    ) -> dict[str, Column]:
+        """Typed columns over the binary wire, paged in
+        ``wire_rows_bin`` strides. Multi-chunk reads are NOT one atomic
+        store snapshot; the server echoes the collection's mutation
+        counter per chunk, and a mismatch (a write landed between
+        chunks) restarts the read — after ``LO_READ_RETRIES`` (default
+        3) torn attempts the last result is returned best-effort, which
+        matches the reference's own read semantics (Mongo cursors don't
+        snapshot either)."""
+        retries = int(os.environ.get("LO_READ_RETRIES", "3"))
+        for _ in range(max(retries, 1)):
+            out, torn = self._read_column_arrays_once(
+                collection, fields, start, limit, check_rev=True
+            )
+            if not torn:
+                return out
+        # Still torn after retries: read to completion WITHOUT the rev
+        # check — complete but non-snapshot, the Mongo-cursor semantics
+        # (never a silently truncated result).
+        out, _ = self._read_column_arrays_once(
+            collection, fields, start, limit, check_rev=False
+        )
+        return out
+
+    def _read_column_arrays_once(
+        self,
+        collection: str,
+        fields: Optional[list[str]],
+        start: int,
+        limit: Optional[int],
+        check_rev: bool = True,
+    ) -> tuple[dict[str, Column], bool]:
+        out: dict[str, Column] = {}
+        fetched = 0
+        rev: Optional[int] = None
+        while True:
+            chunk_limit = self.wire_rows_bin
+            if limit is not None:
+                chunk_limit = min(chunk_limit, limit - fetched)
+                if chunk_limit <= 0:
+                    break
+            columns, extra = self._post_for_frame(
+                f"/c/{collection}/read_columns_bin",
+                {
+                    "fields": fields,
+                    "start": start + fetched,
+                    "limit": chunk_limit,
+                },
+            )
+            chunk_rev = extra.get("rev", -1)
+            if rev is None:
+                rev = chunk_rev
+            elif check_rev and rev != -1 and chunk_rev != rev:
+                return out, True  # a write interleaved: torn read
+            elif chunk_rev != rev:
+                rev = chunk_rev  # unchecked mode: follow the rev along
+            if not out:
+                out = columns
+            else:
+                for name, column in columns.items():
+                    existing = out.get(name)
+                    if existing is None:
+                        # field appeared mid-read (unchecked mode):
+                        # earlier rows lack it → pad prefix
+                        existing = Column.pads(fetched)
+                    out[name] = existing.append_column(column)
+            chunk_rows = max((len(c) for c in columns.values()), default=0)
+            fetched += chunk_rows
+            if chunk_rows < chunk_limit or chunk_rows == 0:
+                break
+        return out, False
 
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
         return self._post(f"/c/{collection}/aggregate", {"pipeline": pipeline})[

@@ -16,6 +16,7 @@ from typing import Iterable, Optional, Sequence
 
 import numpy as np
 
+from learningorchestra_tpu.core.columns import Column
 from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore
 
 NUMBER = "number"
@@ -89,8 +90,22 @@ class ColumnTable:
         collection: str,
         fields: Optional[list[str]] = None,
     ) -> "ColumnTable":
-        """Bulk columnar read of a dataset (excludes the metadata row)."""
-        return cls.from_lists(store.read_columns(collection, fields))
+        """Bulk columnar read of a dataset (excludes the metadata row).
+
+        Rides the typed-column plane (``read_column_arrays``): numeric
+        kinds hand their float64 buffers over directly — zero per-cell
+        conversion between storage and the design matrix."""
+        arrays = store.read_column_arrays(collection, fields)
+        columns: dict[str, np.ndarray] = {}
+        for name, column in arrays.items():
+            if column.kind in ("f8", "i8", "num"):
+                columns[name] = column.to_float64()
+            else:
+                # str/obj/bool/empty keep object semantics (bools and
+                # all-null columns are STRING-typed here, matching
+                # column_type's contract)
+                columns[name] = column.to_object()
+        return cls(columns)
 
     # --- basic relational verbs -----------------------------------------------
     @property
@@ -162,6 +177,18 @@ class ColumnTable:
         return np.stack([self.columns[f] for f in fields], axis=1)
 
     # --- store round-trip -----------------------------------------------------
+    def store_columns(self) -> dict[str, Column]:
+        """Columns as typed :class:`Column` carriers (float64 NaN →
+        null mask) — the zero-conversion shape ``insert_column_arrays``
+        takes."""
+        out: dict[str, Column] = {}
+        for name, column in self.columns.items():
+            if column.dtype == np.float64:
+                out[name] = Column.from_numpy(column)
+            else:
+                out[name] = Column.from_values(column.tolist())
+        return out
+
     def value_columns(self) -> dict[str, list]:
         """Columns as plain Python lists with the store's missing-value
         convention (numeric NaN → ``None``) — the shape
@@ -194,6 +221,9 @@ class ColumnTable:
 
 
 BATCH_SIZE = 4096
+# Typed columns batch far wider: the per-batch cost is one buffer slice
+# + one WAL record, not per-value JSON.
+ARRAY_BATCH_SIZE = 1 << 20
 
 
 def _write_initial_metadata(store: DocumentStore, collection: str, meta: dict) -> None:
@@ -202,25 +232,37 @@ def _write_initial_metadata(store: DocumentStore, collection: str, meta: dict) -
     store.insert_one(collection, initial)
 
 
-def num_column_rows(columns: dict[str, list]) -> int:
+def num_column_rows(columns: dict) -> int:
     return len(next(iter(columns.values()))) if columns else 0
 
 
 def insert_columns_batched(
     store: DocumentStore,
     collection: str,
-    columns: dict[str, list],
+    columns: dict,
     start_id: int = 1,
-    batch_size: int = BATCH_SIZE,
+    batch_size: Optional[int] = None,
 ) -> int:
     """Append ``columns`` as rows ``start_id..`` in ``batch_size`` slices
     (bounds per-call WAL record / wire message sizes). Returns the row
-    count. The one batching loop every columnar writer shares."""
+    count. The one batching loop every columnar writer shares — values
+    may be plain lists or typed :class:`Column` carriers (which slice
+    by buffer and batch ~256× wider)."""
     num_rows = num_column_rows(columns)
+    typed = any(isinstance(values, Column) for values in columns.values())
+    if batch_size is None:
+        batch_size = ARRAY_BATCH_SIZE if typed else BATCH_SIZE
+
+    def part(values, start: int, stop: int):
+        if isinstance(values, Column):
+            return values.slice(start, stop)
+        return values[start:stop]
+
     for start in range(0, num_rows, batch_size):
+        stop = min(start + batch_size, num_rows)
         store.insert_columns(
             collection,
-            {name: values[start : start + batch_size] for name, values in columns.items()},
+            {name: part(values, start, stop) for name, values in columns.items()},
             start_id=start_id + start,
         )
     return num_rows
@@ -253,14 +295,15 @@ def write_documents(
 def write_columns(
     store: DocumentStore,
     collection: str,
-    columns: dict[str, list],
+    columns: dict,
     metadata: dict,
     ids: Optional[Sequence] = None,
-    batch_size: int = BATCH_SIZE,
+    batch_size: Optional[int] = None,
 ) -> None:
     """Write a dataset column-major under the same ``finished`` contract
     as :func:`write_documents` — the fast path: the store keeps the body
-    as a columnar block, no per-row dicts anywhere.
+    as a columnar block, no per-row dicts anywhere. ``columns`` values
+    may be lists or typed :class:`Column` carriers.
 
     ``ids`` (when given) must be the contiguous ``1..N`` range a block
     requires; non-contiguous ids take the row-document fallback.
@@ -272,13 +315,35 @@ def write_columns(
     contiguous_start = 1
     if ids is not None:
         first = int(ids[0]) if num_rows else 1
-        if any(int(ids[i]) != first + i for i in range(num_rows)):
+        contiguous = True
+        if isinstance(ids, np.ndarray) and np.issubdtype(ids.dtype, np.number):
+            contiguous = bool(
+                np.array_equal(ids, np.arange(first, first + num_rows))
+            )
+        else:
+            contiguous = all(
+                int(ids[i]) == first + i for i in range(num_rows)
+            )
+        if not contiguous:
+            value_lists = {
+                name: (
+                    values.tolist() if isinstance(values, Column) else values
+                )
+                for name, values in columns.items()
+            }
             documents = []
             for i in range(num_rows):
-                document = {name: values[i] for name, values in columns.items()}
-                document[ROW_ID] = ids[i]
+                document = {
+                    name: values[i] for name, values in value_lists.items()
+                }
+                doc_id = ids[i]
+                document[ROW_ID] = (
+                    doc_id.item() if isinstance(doc_id, np.generic) else doc_id
+                )
                 documents.append(document)
-            write_documents(store, collection, documents, metadata, batch_size)
+            write_documents(
+                store, collection, documents, metadata, batch_size or BATCH_SIZE
+            )
             return
         contiguous_start = first
 
@@ -292,8 +357,11 @@ def write_table(
     collection: str,
     table: ColumnTable,
     metadata: dict,
-    batch_size: int = BATCH_SIZE,
+    batch_size: Optional[int] = None,
 ) -> None:
     """Write a :class:`ColumnTable` to the store under the ``finished``
-    contract, column-major (see :func:`write_columns`)."""
-    write_columns(store, collection, table.value_columns(), metadata, batch_size=batch_size)
+    contract, column-major over the typed plane (see
+    :func:`write_columns`)."""
+    write_columns(
+        store, collection, table.store_columns(), metadata, batch_size=batch_size
+    )

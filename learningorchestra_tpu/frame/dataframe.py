@@ -240,3 +240,42 @@ class DataFrame:
                 "them in preprocessor_code before fitting"
             )
         return labels.astype(np.int32)
+
+    def device_matrix(self, features_col: str, mesh=None):
+        """The assembled feature matrix padded + row-sharded on the
+        mesh, cached on the frame: when N classifiers predict over the
+        same test/eval frame, the host→device transfer happens ONCE,
+        not per model — the reference re-reads its dataframes per
+        evaluator instead (model_builder.py:205-224)."""
+        import threading
+
+        from learningorchestra_tpu.ml.base import resolve_mesh, shard_matrix
+
+        mesh = resolve_mesh(mesh)
+        cache = self.__dict__.setdefault("_device_matrices", {})
+        lock = self.__dict__.setdefault("_device_lock", threading.Lock())
+        key = (features_col, id(mesh))
+        with lock:
+            cached = cache.get(key)
+            if cached is None:
+                cached = shard_matrix(self.feature_matrix(features_col), mesh)
+                cache[key] = cached
+        return cached
+
+    def device_labels(self, label_col: str, mesh=None):
+        """The label vector padded + row-sharded on the mesh, cached on
+        the frame (see :meth:`device_matrix`)."""
+        import threading
+
+        from learningorchestra_tpu.ml.base import resolve_mesh, shard_labels
+
+        mesh = resolve_mesh(mesh)
+        cache = self.__dict__.setdefault("_device_matrices", {})
+        lock = self.__dict__.setdefault("_device_lock", threading.Lock())
+        key = ("labels:" + label_col, id(mesh))
+        with lock:
+            cached = cache.get(key)
+            if cached is None:
+                cached = shard_labels(self.label_vector(label_col), mesh)
+                cache[key] = cached
+        return cached

@@ -32,11 +32,69 @@ def resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
     return mesh if mesh is not None else default_mesh()
 
 
+class DeviceMatrix:
+    """A feature matrix already padded + row-sharded on the mesh.
+
+    The builder shards the shared test/eval matrices ONCE and every
+    classifier predicts against the same device buffers —
+    ``prepare_xy`` passes them straight through, so N models cost one
+    host→device transfer, not N (the tail the reference pays per
+    evaluator, model_builder.py:205-224)."""
+
+    __slots__ = ("data", "mask", "rows", "mesh")
+
+    def __init__(self, data: jax.Array, mask: jax.Array, rows: int, mesh: Mesh):
+        self.data = data
+        self.mask = mask
+        self.rows = rows
+        self.mesh = mesh
+
+    def __len__(self) -> int:
+        return self.rows
+
+
+def shard_matrix(X: np.ndarray, mesh: Optional[Mesh] = None) -> DeviceMatrix:
+    """Pad + row-shard a feature matrix once, for reuse across models."""
+    mesh = resolve_mesh(mesh)
+    X = np.asarray(X)
+    X_dev, mask = shard_rows(X, mesh, dtype=np.float32)
+    return DeviceMatrix(X_dev, mask, len(X), mesh)
+
+
+class DeviceLabels:
+    """A label vector already padded + row-sharded, with its class count
+    captured host-side (the scatter in the device metrics needs a static
+    bound). Shared across classifier threads like :class:`DeviceMatrix`."""
+
+    __slots__ = ("data", "num_classes", "mesh")
+
+    def __init__(self, data: jax.Array, num_classes: int, mesh: Mesh):
+        self.data = data
+        self.num_classes = num_classes
+        self.mesh = mesh
+
+
+def shard_labels(y: np.ndarray, mesh: Optional[Mesh] = None) -> DeviceLabels:
+    mesh = resolve_mesh(mesh)
+    y = np.asarray(y)
+    y_dev, _ = shard_rows(y, mesh, dtype=np.int32)
+    return DeviceLabels(y_dev, infer_num_classes(y), mesh)
+
+
 def prepare_xy(
-    X: np.ndarray, y: Optional[np.ndarray], mesh: Mesh
+    X, y: Optional[np.ndarray], mesh: Mesh
 ) -> tuple[jax.Array, Optional[jax.Array], jax.Array]:
     """Pad + row-shard features (float32), labels (int32) and the
-    validity mask over the mesh's data axis."""
+    validity mask over the mesh's data axis. A :class:`DeviceMatrix`
+    sharded on the same mesh passes through without any transfer."""
+    if isinstance(X, DeviceMatrix):
+        if X.mesh is mesh:
+            y_dev = None
+            if y is not None:
+                y_dev, _ = shard_rows(np.asarray(y), mesh, dtype=np.int32)
+            return X.data, y_dev, X.mask
+        # mesh mismatch: fall back through host memory
+        X = np.asarray(jax.device_get(X.data))[: X.rows]
     X_dev, mask = shard_rows(np.asarray(X), mesh, dtype=np.float32)
     y_dev = None
     if y is not None:
@@ -51,13 +109,69 @@ def infer_num_classes(y: np.ndarray) -> int:
 
 
 class FittedModel:
-    """Base for fitted models: numpy in, numpy out, device inside."""
+    """Base for fitted models: numpy (or :class:`DeviceMatrix`) in,
+    numpy out, device inside.
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
+    Subclasses implement ``_device_eval(X) -> (labels, probs, mask)``
+    (all padded, device-resident); the base class provides host-facing
+    predict/evaluate built on it with the minimum number of device
+    round trips — one forward pass serves labels, probabilities AND
+    on-device metrics (the reference runs two JVM evaluators plus a
+    collect over the same predictions, model_builder.py:205-247)."""
+
+    mesh: "Mesh"
+
+    def _device_eval(self, X):
         raise NotImplementedError
 
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        raise NotImplementedError
+# Every current model's labels are argmax(probs) (softmax/posterior/
+# ensemble-mean are all argmax-monotonic), so the host can rebuild them
+# from the probabilities and the label buffer never has to travel.
+    labels_from_probs = True
+
+    def _eval(self, X) -> tuple[np.ndarray, np.ndarray]:
+        labels, probs, _ = self._device_eval(X)
+        n = len(X)
+        if jax.process_count() > 1:
+            from learningorchestra_tpu.parallel.multihost import fetch
+
+            return np.asarray(fetch(labels))[:n], np.asarray(fetch(probs))[:n]
+        if self.labels_from_probs:
+            # ONE device→host transfer — transfers are latency-bound
+            probs_np = np.asarray(jax.device_get(probs))[:n]
+            return np.argmax(probs_np, axis=1), probs_np
+        labels_np, probs_np = jax.device_get((labels, probs))
+        return np.asarray(labels_np)[:n], np.asarray(probs_np)[:n]
+
+    def predict(self, X) -> np.ndarray:
+        return self._eval(X)[0]
+
+    def predict_proba(self, X) -> np.ndarray:
+        return self._eval(X)[1]
+
+    def predict_both(self, X) -> tuple[np.ndarray, np.ndarray]:
+        """``(labels, probabilities)`` from ONE forward pass — calling
+        predict then predict_proba would run the program twice."""
+        return self._eval(X)
+
+    def evaluate(self, X, y_true: np.ndarray) -> tuple[float, float]:
+        """``(accuracy, weighted_f1)`` with the confusion matrix built
+        ON DEVICE from the forward pass — one dispatch, two scalars
+        back; predictions never round-trip through host memory."""
+        from learningorchestra_tpu.ml.evaluation import masked_metrics
+        from learningorchestra_tpu.parallel.sharding import shard_rows
+
+        labels, probs, mask = self._device_eval(X)
+        if isinstance(y_true, DeviceLabels):  # pre-sharded by the builder
+            y_dev = y_true.data
+            num_classes = max(int(probs.shape[-1]), y_true.num_classes)
+        else:
+            num_classes = max(int(probs.shape[-1]), infer_num_classes(y_true))
+            y_dev, _ = shard_rows(np.asarray(y_true), self.mesh, dtype=np.int32)
+        accuracy, weighted_f1 = masked_metrics(y_dev, labels, mask, num_classes)
+        # one transfer for both scalars
+        accuracy, weighted_f1 = jax.device_get((accuracy, weighted_f1))
+        return float(accuracy), float(weighted_f1)
 
 
 def make_classifier(name: str, mesh: Optional[Mesh] = None):

@@ -28,8 +28,24 @@ def confusion_matrix(
 
 
 @partial(jax.jit, static_argnames=("num_classes",))
-def _metrics(y_true: jax.Array, y_pred: jax.Array, num_classes: int):
-    cm = confusion_matrix(y_true, y_pred, num_classes)
+def masked_metrics(
+    y_true: jax.Array, y_pred: jax.Array, weights: jax.Array, num_classes: int
+):
+    """``(accuracy, weighted_f1)`` over the valid rows only — the
+    device-resident evaluation the builder fuses into the forward pass
+    (``FittedModel.evaluate``): padded rows carry weight 0, so sharded
+    padded predictions never bias the confusion matrix."""
+    index = y_true.astype(jnp.int32) * num_classes + y_pred.astype(jnp.int32)
+    flat = (
+        jnp.zeros(num_classes * num_classes, dtype=jnp.float32)
+        .at[index]
+        .add(weights.astype(jnp.float32))
+    )
+    return _metrics_from_cm(flat.reshape(num_classes, num_classes))
+
+
+@jax.jit
+def _metrics_from_cm(cm: jax.Array):
     total = cm.sum()
     accuracy = jnp.trace(cm) / total
     true_positive = jnp.diag(cm)
@@ -42,6 +58,24 @@ def _metrics(y_true: jax.Array, y_pred: jax.Array, num_classes: int):
     )
     weighted_f1 = (f1 * support).sum() / total
     return accuracy, weighted_f1
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _metrics(y_true: jax.Array, y_pred: jax.Array, num_classes: int):
+    cm = confusion_matrix(y_true, y_pred, num_classes)
+    return _metrics_from_cm(cm)
+
+
+def evaluate_both(y_true: np.ndarray, y_pred: np.ndarray) -> tuple[float, float]:
+    """``(accuracy, weighted_f1)`` in ONE device dispatch — the builder's
+    evaluate phase calls this instead of two separate metric programs
+    (one confusion matrix serves both, exactly like the reference's two
+    evaluators over one prediction frame, model_builder.py:205-224)."""
+    num_classes = int(max(np.max(y_true), np.max(y_pred))) + 1
+    accuracy, weighted_f1 = _metrics(
+        jnp.asarray(y_true, jnp.int32), jnp.asarray(y_pred, jnp.int32), num_classes
+    )
+    return float(accuracy), float(weighted_f1)
 
 
 def accuracy_score(y_true: np.ndarray, y_pred: np.ndarray) -> float:

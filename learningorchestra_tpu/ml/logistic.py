@@ -108,17 +108,10 @@ class LogisticRegressionModel(FittedModel):
         self.scale = scale
         self.mesh = mesh
 
-    def _eval(self, X: np.ndarray):
+    def _device_eval(self, X):
         X_dev, _, mask = prepare_xy(X, None, self.mesh)
         labels, probs = _forward(self.params, X_dev, self.mean, self.scale)
-        n = len(X)
-        return fetch(labels)[:n], fetch(probs)[:n]
-
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[0]
-
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[1]
+        return labels, probs, mask
 
 
 class LogisticRegression:

@@ -56,17 +56,10 @@ class NaiveBayesModel(FittedModel):
         self.prior = prior
         self.mesh = mesh
 
-    def _eval(self, X: np.ndarray):
-        X_dev, _, _ = prepare_xy(X, None, self.mesh)
+    def _device_eval(self, X):
+        X_dev, _, mask = prepare_xy(X, None, self.mesh)
         labels, probs = _forward(self.theta, self.prior, X_dev)
-        n = len(X)
-        return fetch(labels)[:n], fetch(probs)[:n]
-
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[0]
-
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[1]
+        return labels, probs, mask
 
 
 class NaiveBayes:

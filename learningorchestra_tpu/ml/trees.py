@@ -258,8 +258,8 @@ class _TreeEnsembleModel(FittedModel):
         self.mesh = mesh
         self.max_depth = max_depth
 
-    def _eval(self, X: np.ndarray):
-        X_dev, _, _ = prepare_xy(X, None, self.mesh)
+    def _device_eval(self, X):
+        X_dev, _, mask = prepare_xy(X, None, self.mesh)
         probs = _ensemble_forward(
             X_dev,
             self.features_heap,
@@ -267,15 +267,7 @@ class _TreeEnsembleModel(FittedModel):
             self.leaf_probs,
             self.max_depth,
         )
-        n = len(X)
-        probs = fetch(probs)[:n]
-        return np.argmax(probs, axis=1), probs
-
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[0]
-
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[1]
+        return jnp.argmax(probs, axis=1), probs, mask
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -465,8 +457,8 @@ class GBTModel(FittedModel):
         self.mesh = mesh
         self.max_depth = max_depth
 
-    def _eval(self, X: np.ndarray):
-        X_dev, _, _ = prepare_xy(X, None, self.mesh)
+    def _device_eval(self, X):
+        X_dev, _, mask = prepare_xy(X, None, self.mesh)
         probs = _gbt_forward(
             X_dev,
             self.f0,
@@ -476,15 +468,7 @@ class GBTModel(FittedModel):
             jnp.float32(self.step),
             self.max_depth,
         )
-        n = len(X)
-        probs = fetch(probs)[:n]
-        return np.argmax(probs, axis=1), probs
-
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[0]
-
-    def predict_proba(self, X: np.ndarray) -> np.ndarray:
-        return self._eval(X)[1]
+        return jnp.argmax(probs, axis=1), probs, mask
 
 
 class GBTClassifier:

@@ -203,6 +203,44 @@ def _strings_column(cells: list[str]) -> np.ndarray:
     return out
 
 
+def read_csv_string_columns(path: str):
+    """Header plus every column as an Arrow-layout string
+    :class:`~learningorchestra_tpu.core.columns.Column`, built straight
+    from the native parser's NUL-joined bulk export — raw cell strings
+    (``""`` for empty, the ingest contract, reference database.py:
+    156-169) with **zero Python string objects materialized**. Returns
+    ``None`` when the native parser is unavailable or rejects the file.
+    """
+    from learningorchestra_tpu.core.columns import Column
+
+    lib = _get_lib()
+    if lib is None:
+        return None
+    try:
+        parsed = NativeCsv(path)
+    except OSError:
+        return None
+    with parsed:
+        header = parsed.header()
+        columns = []
+        for j in range(parsed.num_cols):
+            total = int(lib.csv_col_string_bytes(parsed._handle, j))
+            buffer = ctypes.create_string_buffer(total)
+            lib.csv_fill_strings(parsed._handle, j, buffer)
+            try:
+                columns.append(
+                    Column.from_nul_joined(buffer.raw[:total], parsed.num_rows)
+                )
+            except ValueError:
+                # a cell contained a literal NUL: exact per-cell path
+                columns.append(
+                    Column.from_strings(
+                        [parsed.cell(i, j) for i in range(parsed.num_rows)]
+                    )
+                )
+    return header, columns
+
+
 def read_csv_raw_columns(path: str) -> Optional[tuple[list[str], list[list[str]]]]:
     """Header plus every column as raw cell strings (``""`` for empty) —
     the ingest contract, which stores values untyped (reference:

@@ -6,16 +6,19 @@ a Spark job reads the parent collection, filters out the metadata row,
 output collection, and writes a metadata document whose ``finished`` flag
 flips when the job completes.
 
-Here projection is a single bulk columnar move: one ``read_columns`` scan
-(fields + ``_id`` together, so values and row ids can never mis-pair) and
-one column-major write under the ``finished`` contract — column lists in,
-column lists out, no per-row dicts. Row ``_id``s are preserved, matching
-the reference's appending of ``_id`` to the projection fields
+Here projection is a single bulk columnar move: one
+``read_column_arrays`` scan (fields + ``_id`` together, so values and
+row ids can never mis-pair) and one column-major write under the
+``finished`` contract — typed buffers in, typed buffers out, no per-row
+dicts and no per-cell conversion anywhere. Row ``_id``s are preserved,
+matching the reference's appending of ``_id`` to the projection fields
 (projection_image/server.py:104-106). Values are copied raw — projection
 never coerces types; that is the fieldtypes service's job.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 from learningorchestra_tpu.core.ingest import timestamp
 from learningorchestra_tpu.core.store import ROW_ID, DocumentStore
@@ -41,9 +44,15 @@ def project(
             raise KeyError(
                 f"fields {missing} not in dataset {parent_filename!r}"
             )
-    columns = store.read_columns(parent_filename, fields=field_names + [ROW_ID])
-    ids = columns.pop(ROW_ID)
-    num_rows = len(ids)
+    columns = store.read_column_arrays(
+        parent_filename, fields=field_names + [ROW_ID]
+    )
+    ids_column = columns.pop(ROW_ID)
+    num_rows = len(ids_column)
+    if ids_column.kind == "i8":
+        ids = ids_column.data[:num_rows]
+    else:
+        ids = np.asarray(ids_column.tolist())
 
     write_columns(
         store,

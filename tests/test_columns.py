@@ -1,0 +1,354 @@
+"""Unit tests for the typed column engine (core/columns.py) — the cell
+semantics the store's block layer is built on: kind inference, int/float
+round-trip fidelity, null vs missing distinction, copy-on-write
+snapshots, appends with kind promotion, and all three serializations
+(wire parts, WAL JSON records, numpy hand-off)."""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core.columns import MISSING, Column, merge_kind
+
+
+class TestKinds:
+    def test_int_column_roundtrips_ints(self):
+        col = Column.from_values([1, 2, 3])
+        assert col.kind == "i8"
+        values = col.tolist()
+        assert values == [1, 2, 3]
+        assert all(type(v) is int for v in values)
+
+    def test_float_column_roundtrips_floats(self):
+        col = Column.from_values([1.5, 2.0])
+        assert col.kind == "f8"
+        values = col.tolist()
+        assert values == [1.5, 2.0]
+        assert all(type(v) is float for v in values)
+
+    def test_mixed_int_float_preserves_each(self):
+        # the dtype converter's int-collapse contract: "28" → 28, "2.5" → 2.5
+        col = Column.from_values([28, 2.5, 7])
+        assert col.kind == "num"
+        values = col.tolist()
+        assert values == [28, 2.5, 7]
+        assert type(values[0]) is int and type(values[1]) is float
+
+    def test_string_column(self):
+        col = Column.from_values(["a", "bb", ""])
+        assert col.kind == "str"
+        assert col.tolist() == ["a", "bb", ""]
+
+    def test_unicode_strings(self):
+        values = ["héllo", "wörld", "日本", ""]
+        col = Column.from_values(values)
+        assert col.tolist() == values
+        assert col.get(2) == "日本"
+
+    def test_bool_column_stays_bool(self):
+        col = Column.from_values([True, False, True])
+        assert col.kind == "bool"
+        values = col.tolist()
+        assert values == [True, False, True]
+        assert all(type(v) is bool for v in values)
+
+    def test_mixed_bool_int_falls_to_obj(self):
+        col = Column.from_values([True, 1])
+        assert col.kind == "obj"
+        assert col.tolist() == [True, 1]
+
+    def test_none_tracked_in_mask(self):
+        col = Column.from_values([1.0, None, 3.0])
+        assert col.kind == "f8"
+        assert col.tolist() == [1.0, None, 3.0]
+        assert col.get(1) is None
+
+    def test_missing_distinct_from_none(self):
+        col = Column.from_values([1, MISSING, None])
+        assert col.get(1) is MISSING
+        assert col.get(2) is None
+        assert col.tolist(pad_as_none=True) == [1, None, None]
+        assert col.tolist(pad_as_none=False) == [1, MISSING, None]
+
+    def test_nan_reads_as_none(self):
+        col = Column.from_values([1.0, float("nan")])
+        assert col.tolist() == [1.0, None]
+
+    def test_huge_int_falls_back_to_obj(self):
+        big = 2**100
+        col = Column.from_values([1, big])
+        assert col.tolist() == [1, big]
+
+    def test_obj_kind_for_lists(self):
+        col = Column.from_values([[0.1, 0.9], [0.8, 0.2]])
+        assert col.kind == "obj"
+        assert col.tolist() == [[0.1, 0.9], [0.8, 0.2]]
+
+
+class TestAppend:
+    def test_same_kind_append(self):
+        col = Column.from_values([1, 2])
+        col = col.append_column(Column.from_values([3, 4]))
+        assert col.tolist() == [1, 2, 3, 4]
+
+    def test_int_then_float_promotes_to_num(self):
+        col = Column.from_values([1, 2])
+        col = col.append_column(Column.from_values([2.5]))
+        assert col.kind == "num"
+        assert col.tolist() == [1, 2, 2.5]
+
+    def test_str_then_int_promotes_to_obj(self):
+        col = Column.from_values(["a"])
+        col = col.append_column(Column.from_values([7]))
+        assert col.kind == "obj"
+        assert col.tolist() == ["a", 7]
+
+    def test_pads_then_values_adopts_kind(self):
+        col = Column.pads(2)
+        col = col.append_column(Column.from_values([5, 6]))
+        assert col.tolist(pad_as_none=False) == [MISSING, MISSING, 5, 6]
+        assert col.get(3) == 6
+
+    def test_values_then_pads(self):
+        col = Column.from_values(["x", "y"])
+        col = col.append_pads(2)
+        assert col.tolist(pad_as_none=False) == ["x", "y", MISSING, MISSING]
+
+    def test_many_appends_amortized(self):
+        col = Column.from_values([0.0])
+        for i in range(1, 300):
+            col = col.append_column(Column.from_values([float(i)]))
+        assert col.size == 300
+        assert col.get(299) == 299.0
+
+    def test_append_strings_grows_buffers(self):
+        col = Column.from_values(["ab"])
+        for i in range(100):
+            col = col.append_column(Column.from_values([f"s{i}"]))
+        assert col.get(100) == "s99"
+        assert col.size == 101
+
+
+class TestSet:
+    def test_set_same_kind_in_place(self):
+        col = Column.from_values([1, 2, 3])
+        col = col.set(1, 9)
+        assert col.tolist() == [1, 9, 3]
+
+    def test_set_float_into_int_promotes(self):
+        col = Column.from_values([1, 2])
+        col = col.set(0, 0.5)
+        assert col.kind == "num"
+        assert col.tolist() == [0.5, 2]
+        assert type(col.tolist()[1]) is int
+
+    def test_set_string_cell_via_edits(self):
+        col = Column.from_values(["a", "b", "c"])
+        col = col.set(1, "a-much-longer-value")
+        assert col.tolist() == ["a", "a-much-longer-value", "c"]
+        assert col.get(1) == "a-much-longer-value"
+
+    def test_set_none_and_back(self):
+        col = Column.from_values([1, 2])
+        col = col.set(0, None)
+        assert col.get(0) is None
+        col = col.set(0, 7)
+        assert col.get(0) == 7
+
+    def test_set_str_into_float_promotes_to_obj(self):
+        col = Column.from_values([1.0, 2.0])
+        col = col.set(1, "oops")
+        assert col.kind == "obj"
+        assert col.tolist() == [1.0, "oops"]
+
+    def test_set_nan_reads_none(self):
+        col = Column.from_values([1.0, 2.0])
+        col = col.set(0, float("nan"))
+        assert col.get(0) is None
+
+
+class TestSnapshot:
+    def test_snapshot_isolated_from_set(self):
+        col = Column.from_values([1, 2, 3])
+        snap = col.snapshot()
+        col = col.set(0, 99)
+        assert snap.tolist() == [1, 2, 3]
+        assert col.tolist() == [99, 2, 3]
+
+    def test_snapshot_isolated_from_append(self):
+        col = Column.from_values([1.0])
+        snap = col.snapshot()
+        for i in range(50):
+            col = col.append_column(Column.from_values([float(i)]))
+        assert snap.tolist() == [1.0]
+
+    def test_snapshot_isolated_from_append_then_set(self):
+        # append may swap buffers without clearing masks' shared state;
+        # a later set must still not tear the snapshot
+        col = Column.from_values([1.0, None])
+        snap = col.snapshot()
+        col = col.append_column(Column.from_values([3.0] * 100))
+        col = col.set(0, None)
+        col = col.set(1, 5.0)
+        assert snap.tolist() == [1.0, None]
+
+    def test_str_snapshot_isolated_from_edits(self):
+        col = Column.from_values(["a", "b"])
+        snap = col.snapshot()
+        col = col.set(0, "zzz")
+        assert snap.tolist() == ["a", "b"]
+
+
+class TestSlice:
+    def test_slice_values(self):
+        col = Column.from_values([1, 2, 3, 4, 5])
+        assert col.slice(1, 4).tolist() == [2, 3, 4]
+
+    def test_slice_strings(self):
+        col = Column.from_values(["aa", "bb", "cc"])
+        part = col.slice(1, 3)
+        assert part.tolist() == ["bb", "cc"]
+
+    def test_slice_with_masks(self):
+        col = Column.from_values([1.0, None, 3.0, None])
+        assert col.slice(1, 4).tolist() == [None, 3.0, None]
+
+
+class TestUniqueCounts:
+    def _as_pairs(self, groups):
+        return {(g["_id"] if not isinstance(g["_id"], bool) else ("b", g["_id"])): g["count"] for g in groups}
+
+    def test_int_counts(self):
+        col = Column.from_values([3, 1, 3, 3])
+        pairs = self._as_pairs(col.unique_counts())
+        assert pairs == {3: 3, 1: 1}
+
+    def test_string_counts(self):
+        col = Column.from_values(["a", "b", "a"])
+        pairs = self._as_pairs(col.unique_counts())
+        assert pairs == {"a": 2, "b": 1}
+
+    def test_none_group(self):
+        col = Column.from_values([1.0, None, None])
+        pairs = self._as_pairs(col.unique_counts())
+        assert pairs == {1.0: 1, None: 2}
+
+    def test_bool_counts_stay_bool(self):
+        col = Column.from_values([True, True, False])
+        groups = col.unique_counts()
+        keys = {type(g["_id"]) for g in groups}
+        assert keys == {bool}
+
+    def test_num_kind_keeps_int_keys(self):
+        col = Column.from_values([28, 2.5, 28])
+        pairs = col.unique_counts()
+        by_key = {repr(g["_id"]): g["count"] for g in pairs}
+        assert by_key == {"28": 2, "2.5": 1}
+
+
+class TestSerialization:
+    @pytest.mark.parametrize(
+        "values",
+        [
+            [1, 2, 3],
+            [1.5, None, 2.5],
+            ["a", "", "ccc", None],
+            [True, False],
+            [28, 2.5, None],
+            [[1, 2], None, "x", 5],
+            [1, MISSING, None],
+        ],
+    )
+    def test_wire_roundtrip(self, values):
+        col = Column.from_values(values)
+        meta, buffers = col.wire_parts()
+        back = Column.from_wire_parts(meta, buffers)
+        assert back.tolist(pad_as_none=False) == Column.from_values(
+            values
+        ).tolist(pad_as_none=False)
+
+    @pytest.mark.parametrize(
+        "values",
+        [[1, 2], [1.5, None], ["a", None, "b"], [28, 2.5], [True], [MISSING, 7]],
+    )
+    def test_json_record_roundtrip(self, values):
+        col = Column.from_values(values)
+        back = Column.from_json_record(col.to_json_record())
+        assert back.tolist(pad_as_none=False) == col.tolist(pad_as_none=False)
+
+    def test_json_record_is_jsonable(self):
+        import json
+
+        col = Column.from_values([1.5, None, 2.0])
+        json.dumps(col.to_json_record())
+
+
+class TestNumpyHandoff:
+    def test_to_float64_with_nulls(self):
+        col = Column.from_values([1, None, 3])
+        arr = col.to_float64()
+        assert arr[0] == 1.0 and np.isnan(arr[1]) and arr[2] == 3.0
+
+    def test_from_numpy_float(self):
+        col = Column.from_numpy(np.array([1.0, np.nan, 3.0]))
+        assert col.tolist() == [1.0, None, 3.0]
+
+    def test_from_numpy_int(self):
+        col = Column.from_numpy(np.arange(5))
+        assert col.kind == "i8"
+        assert col.tolist() == [0, 1, 2, 3, 4]
+
+    def test_to_object_strings(self):
+        col = Column.from_values(["x", None, "y"])
+        arr = col.to_object()
+        assert arr.dtype == object
+        assert list(arr) == ["x", None, "y"]
+
+    def test_from_nul_joined(self):
+        buffer = b"alpha\x00\x00gamma\x00"
+        col = Column.from_nul_joined(buffer, 3)
+        assert col.tolist() == ["alpha", "", "gamma"]
+
+    def test_tolist_json_safe_types(self):
+        import json
+
+        col = Column.from_values([1, 2])
+        json.dumps(col.tolist())
+        col2 = Column.from_values([True])
+        json.dumps(col2.tolist())
+
+
+class TestReviewRegressions:
+    def test_num_all_float_roundtrips_serialization(self):
+        # a num column whose int-mask is all False must survive the
+        # wire/WAL round trip (the mask ships even when all-False)
+        col = Column.from_values([2.5, 3.5])
+        col = col.set(0, 2.5)  # stays f8; force num via append
+        col = Column.from_values([1, 2.5])
+        col = col.set(0, 0.5)  # intm now all-False
+        back = Column.from_json_record(col.to_json_record())
+        assert back.tolist() == [0.5, 2.5]
+        back2 = Column.from_wire_parts(*col.wire_parts())
+        assert back2.tolist() == [0.5, 2.5]
+        assert back.unique_counts()  # must not crash on intm access
+
+    def test_num_unique_merges_equal_int_and_float(self):
+        # 2 and 2.0 are ONE group (dict/Counter/Mongo semantics); key
+        # type follows the first occurrence
+        col = Column.from_values([2, 2.0, 2])
+        groups = col.unique_counts()
+        assert len(groups) == 1
+        assert groups[0]["count"] == 3
+        assert groups[0]["_id"] == 2 and type(groups[0]["_id"]) is int
+
+    def test_num_unique_float_first_occurrence_keeps_float(self):
+        col = Column.from_values([2.0, 2, 2.5])
+        groups = {repr(g["_id"]): g["count"] for g in col.unique_counts()}
+        assert groups == {"2.0": 2, "2.5": 1}
+
+
+def test_merge_kind_lattice():
+    assert merge_kind("i8", "f8") == "num"
+    assert merge_kind("empty", "str") == "str"
+    assert merge_kind("bool", "i8") == "obj"
+    assert merge_kind("str", "str") == "str"
+    assert merge_kind("num", "i8") == "num"

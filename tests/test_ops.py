@@ -132,3 +132,43 @@ class TestReviewRegressions:
         reopened = InMemoryStore(data_dir=str(tmp_path))
         assert next(reopened.find("c", {ROW_ID: 1}))["x"] == "A"
         assert next(reopened.find("c", {ROW_ID: "7"}))["x"] == "B"
+
+
+class TestDtypeVectorizedParity:
+    """The vectorized converters must match the per-value reference
+    converters exactly, including the grammar/overflow edges the review
+    flagged."""
+
+    def _roundtrip(self, store_factory, values, target):
+        from learningorchestra_tpu.core.store import InMemoryStore
+
+        store = InMemoryStore()
+        store.insert_one("ds", {"_id": 0, "finished": True, "fields": ["x"]})
+        store.insert_columns("ds", {"x": values})
+        convert_field_types(store, "ds", {"x": target})
+        return store.read_columns("ds", ["x"])["x"]
+
+    def test_huge_integral_float_to_string(self):
+        from learningorchestra_tpu.ops.dtype import _to_string
+
+        values = [1e19, 2.5, 28.0]
+        out = self._roundtrip(None, values, "string")
+        assert out == [_to_string(v) for v in values]
+        assert out[0] == "10000000000000000000"
+
+    def test_number_conversion_int_collapse(self):
+        out = self._roundtrip(None, ["28", "2.5", ""], "number")
+        assert out == [28, 2.5, None]
+        assert type(out[0]) is int and type(out[1]) is float
+
+    def test_underscore_grammar_matches_python_float(self):
+        # Python float() accepts "1_0"; numpy's parser rejects it — the
+        # fallback loop must keep Python semantics
+        out = self._roundtrip(None, ["1_0", "2"], "number")
+        assert out == [10, 2]
+
+    def test_bad_string_raises_value_error(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            self._roundtrip(None, ["abc", "2"], "number")
