@@ -32,7 +32,12 @@ def _pca(X, mask, n_components: int):
     n = weights.sum()
     mean = (X * weights[:, None]).sum(axis=0) / n
     centered = (X - mean) * weights[:, None]
-    covariance = centered.T @ centered / (n - 1)
+    # full-f32 passes: the TPU's default bf16 matmul perturbs the tiny
+    # covariance enough to visibly rotate the eigh components
+    covariance = (
+        jnp.dot(centered.T, centered, precision=jax.lax.Precision.HIGHEST)
+        / (n - 1)
+    )
     eigenvalues, eigenvectors = jnp.linalg.eigh(covariance)
     # eigh is ascending; take the top components, largest first.
     components = eigenvectors[:, ::-1][:, :n_components]

@@ -60,10 +60,16 @@ INTERP_CHUNK = 8_192
 
 
 def _squared_distances(A, B):
-    return (
+    # precision=HIGHEST: the TPU's default bf16 matmul makes
+    # ‖a‖²+‖b‖²−2ab come out slightly NEGATIVE for near neighbors once
+    # coordinates grow; 1/(1+d) then blows past zero and the whole
+    # optimization NaNs. Full-f32 passes on the MXU cost ~3× on this one
+    # contraction and keep the identity non-negative to rounding.
+    return jnp.maximum(
         jnp.sum(A**2, axis=1)[:, None]
         + jnp.sum(B**2, axis=1)[None, :]
-        - 2.0 * A @ B.T
+        - 2.0 * jnp.dot(A, B.T, precision=jax.lax.Precision.HIGHEST),
+        0.0,
     )
 
 
@@ -187,7 +193,10 @@ def _optimize(
             total = jax.lax.psum(inv.sum(), DATA_AXIS)
             Q = inv / jnp.maximum(total, 1e-12)
             W = (P_eff - jnp.maximum(Q, 1e-12)) * inv
-            grad_local = 4.0 * (W.sum(axis=1)[:, None] * Y_local - W @ Y)
+            grad_local = 4.0 * (
+                W.sum(axis=1)[:, None] * Y_local
+                - jnp.dot(W, Y, precision=jax.lax.Precision.HIGHEST)
+            )
             return jax.lax.all_gather(
                 grad_local, DATA_AXIS, axis=0, tiled=True
             )
@@ -283,7 +292,7 @@ def _interpolate(mesh: Mesh, X, landmarks, Y_landmarks, perplexity, chunk: int):
             distances = _squared_distances(block, L_full)
             excluded = jnp.zeros(distances.shape, bool)
             p = _calibrate_row_block(distances, excluded, perplexity)
-            return p @ Y_full
+            return jnp.dot(p, Y_full, precision=jax.lax.Precision.HIGHEST)
 
         return jax.lax.map(one_block, blocks).reshape(local, 2)
 
