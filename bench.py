@@ -94,10 +94,10 @@ def _best_of(fn, repeats: int = 3) -> float:
     return min(times)
 
 
-def _make_kernel_suite(X, y, features: int, subset_k: int):
-    """Device setup + the five fit-kernel closures, shared by the
-    default-shape and wide-shape kernel sections (one definition, one
-    configuration to keep in sync)."""
+def _make_kernel_suite(X, y, subset_k: int):
+    """Device setup + the five fit-kernel closures and the suite runner,
+    shared by the default-shape and wide-shape kernel sections (one
+    definition, one configuration to keep in sync)."""
     import jax
     import jax.numpy as jnp
 
@@ -105,6 +105,7 @@ def _make_kernel_suite(X, y, features: int, subset_k: int):
     from learningorchestra_tpu.ml.base import prepare_xy, resolve_mesh
     from learningorchestra_tpu.ml.binning import apply_bins, make_thresholds
 
+    features = X.shape[1]
     mesh = resolve_mesh(None)
     thresholds = jnp.asarray(make_thresholds(X), jnp.float32)
     X_std = (X - X.mean(0)) / np.maximum(X.std(0), 1e-9)
@@ -137,16 +138,17 @@ def _make_kernel_suite(X, y, features: int, subset_k: int):
             trees._gbt_fit(bins, y_dev, mask, 5, 32, 20, jnp.float32(0.1))[3]
         ),
     }
-    return kernels, bins, y_dev, mask
-
-
-def bench_kernels(X, y) -> dict:
-    """Section 1: jitted fit kernels on device-resident data."""
-    kernels, bins, y_dev, mask = _make_kernel_suite(X, y, FEATURES, subset_k=4)
 
     def suite():
         for kernel in kernels.values():
             kernel()
+
+    return kernels, suite, bins, y_dev, mask
+
+
+def bench_kernels(X, y) -> dict:
+    """Section 1: jitted fit kernels on device-resident data."""
+    kernels, suite, bins, y_dev, mask = _make_kernel_suite(X, y, subset_k=4)
 
     suite()  # compile everything once
     # Headline: best-of-2 of the WHOLE suite (same best-of methodology
@@ -242,11 +244,7 @@ def bench_kernels_wide() -> dict:
     yw = ((Xw[:, :8].sum(1) + rng.random(rows, dtype=np.float32) * 20) > 88).astype(
         np.int32
     )
-    kernels, _, _, _ = _make_kernel_suite(Xw, yw, wide_features, subset_k=8)
-
-    def suite():
-        for kernel in kernels.values():
-            kernel()
+    _, suite, _, _, _ = _make_kernel_suite(Xw, yw, subset_k=8)
 
     suite()
     suite_time = _best_of(suite, repeats=1)

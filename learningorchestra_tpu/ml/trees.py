@@ -116,6 +116,24 @@ def _level_histograms(bins, node, channels, n_nodes: int, max_bins: int):
     )
 
 
+def _leaf_sums(leaf_of_row, channels, n_leaves: int):
+    """Per-leaf channel sums — the same MXU-vs-scatter choice as
+    _level_histograms: one-hot matmul while the ``(rows, n_leaves)``
+    intermediate stays small (default depth 5 → 32 leaves), guarded
+    scatter for deep trees where it would not fit."""
+    if n_leaves <= 64:
+        return jnp.dot(
+            jax.nn.one_hot(leaf_of_row, n_leaves, dtype=jnp.float32).T,
+            channels,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    return (
+        jnp.zeros((n_leaves, channels.shape[1]), jnp.float32)
+        .at[leaf_of_row]
+        .add(channels)
+    )
+
+
 def _gini_gain(hist):
     """Split scores from class-count histograms ``(nodes, F, B, C)``.
 
@@ -220,13 +238,7 @@ def _fit_classification_tree(
         bins, one_hot, _gini_gain, max_depth, max_bins, subset_key, subset_k
     )
     num_classes = one_hot.shape[1]
-    # same MXU reformulation as _level_histograms: leaf one-hot matmul
-    # instead of a (vmap-hostile) scatter-add
-    leaf_counts = jnp.dot(
-        jax.nn.one_hot(leaf_of_row, 2**max_depth, dtype=jnp.float32).T,
-        one_hot,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+    leaf_counts = _leaf_sums(leaf_of_row, one_hot, 2**max_depth)
     leaf_probs = leaf_counts / jnp.maximum(leaf_counts.sum(1, keepdims=True), EPS)
     return features_heap, bins_heap, leaf_probs
 
@@ -236,11 +248,7 @@ def _fit_newton_tree(bins, g, h, max_depth, max_bins, lam=1.0):
     features_heap, bins_heap, leaf_of_row = _grow(
         bins, channels, _newton_gain, max_depth, max_bins, None, None
     )
-    sums = jnp.dot(
-        jax.nn.one_hot(leaf_of_row, 2**max_depth, dtype=jnp.float32).T,
-        channels,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+    sums = _leaf_sums(leaf_of_row, channels, 2**max_depth)
     leaf_values = -sums[:, 0] / (sums[:, 1] + lam)
     return features_heap, bins_heap, leaf_values, leaf_of_row
 

