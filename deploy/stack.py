@@ -33,6 +33,12 @@ Environment (all optional):
                         (tests); default: reference ports 5000-5006
 - ``LO_RESTART_DELAY``  seconds between failure and restart (default 5)
 - ``LO_MAX_RESTARTS``   per-child cap (default: unlimited)
+- ``LO_WORKERS``        N > 0 switches to the MULTI-HOST topology:
+                        store + an all-services coordinator + N SPMD
+                        worker processes in one jax.distributed
+                        runtime; any runtime member dying restarts the
+                        whole group (see _supervise_multihost)
+- ``LO_COORD_PORT``     jax.distributed coordinator port (default 12355)
 """
 
 from __future__ import annotations
@@ -62,6 +68,8 @@ SERVICE_NAMES = (
 # "service <name> on <host>:<port>" (services/runner.py) and
 # "store server on <host>:<port>" (core/store_service.py)
 _PORT_LINE = re.compile(r"on [\w.\-]+:(\d+)")
+_SERVICE_PORT_LINE = re.compile(r"service (\w+) on [\w.\-]+:(\d+)")
+_WORKER_READY_LINE = "spmd worker: waiting for jobs"
 
 
 class Child:
@@ -74,8 +82,11 @@ class Child:
         self.log = log
         self.proc: subprocess.Popen | None = None
         self.port: int | None = None
+        # all-in-one runners announce one port per service
+        self.service_ports: dict[str, int] = {}
         self.restarts = 0
         self._port_event = threading.Event()
+        self._ready_event = threading.Event()  # spmd worker readiness
 
     def start(self) -> None:
         self.proc = subprocess.Popen(
@@ -91,11 +102,25 @@ class Child:
     def _pump(self) -> None:
         proc = self.proc
         for line in proc.stdout:
-            match = _PORT_LINE.search(line)
+            match = _SERVICE_PORT_LINE.search(line)
             if match:
-                self.port = int(match.group(1))
+                # per-service announcement: recorded by NAME only —
+                # self.port stays unset so an all-in-one runner never
+                # publishes an arbitrary service port under its own name
+                self.service_ports[match.group(1)] = int(match.group(2))
                 self._port_event.set()
+            else:
+                match = _PORT_LINE.search(line)
+                if match:
+                    self.port = int(match.group(1))
+                    self._port_event.set()
+            if _WORKER_READY_LINE in line:
+                self._ready_event.set()
             self.log(f"[{self.name}] {line.rstrip()}")
+
+    def wait_ready(self, timeout: float) -> None:
+        if not self._ready_event.wait(timeout):
+            raise TimeoutError(f"{self.name}: not ready within {timeout}s")
 
     def wait_port(self, timeout: float) -> int:
         if not self._port_event.wait(timeout):
@@ -163,12 +188,15 @@ def main() -> int:
     children: dict[str, Child] = {"store": store}
 
     def write_ports() -> None:
+        ports = {
+            name: child.port
+            for name, child in children.items()
+            if child.port is not None
+        }
+        for child in children.values():  # all-in-one runners: per-service
+            ports.update(child.service_ports)
         state = {
-            "ports": {
-                name: child.port
-                for name, child in children.items()
-                if child.port is not None
-            },
+            "ports": ports,
             "pids": {
                 name: child.proc.pid
                 for name, child in children.items()
@@ -191,20 +219,38 @@ def main() -> int:
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
 
+    workers = int(os.environ.get("LO_WORKERS", "0") or 0)
     try:
-        exit_code = _supervise(
-            children,
-            store,
-            base_env,
-            host,
-            ephemeral,
-            restart_delay,
-            max_restarts,
-            write_ports,
-            ports_path,
-            stopping,
-            log,
-        )
+        if workers > 0:
+            exit_code = _supervise_multihost(
+                children,
+                store,
+                base_env,
+                host,
+                ephemeral,
+                restart_delay,
+                max_restarts,
+                write_ports,
+                ports_path,
+                stopping,
+                log,
+                workers,
+                data_dir,
+            )
+        else:
+            exit_code = _supervise(
+                children,
+                store,
+                base_env,
+                host,
+                ephemeral,
+                restart_delay,
+                max_restarts,
+                write_ports,
+                ports_path,
+                stopping,
+                log,
+            )
     finally:
         log("[stack] shutting down")
         for child in children.values():
@@ -269,6 +315,7 @@ def _supervise(
                 log(f"[stack] {name} exited cleanly; not restarting")
                 retired.add(name)
                 child.port = None
+                child.service_ports.clear()
                 write_ports()
                 continue
             if max_restarts is not None and child.restarts >= max_restarts:
@@ -287,6 +334,7 @@ def _supervise(
             time.sleep(restart_delay)
             child._port_event.clear()
             child.port = None
+            child.service_ports.clear()
             if name == "store":
                 child.start()
                 new_port = child.wait_port(60)
@@ -310,6 +358,189 @@ def _supervise(
                 child.start()
                 child.wait_port(120)
             write_ports()
+
+    return exit_code
+
+
+def _supervise_multihost(
+    children,
+    store,
+    base_env,
+    host,
+    ephemeral,
+    restart_delay,
+    max_restarts,
+    write_ports,
+    ports_path,
+    stopping,
+    log,
+    workers: int,
+    data_dir: str,
+) -> int:
+    """The multi-host topology (``LO_WORKERS=N``): store server +
+    coordinator (all seven services, REST, SPMD dispatch) + N worker
+    processes joined into ONE jax.distributed runtime — the reference's
+    sparkmaster + N sparkworker overlay (docker-compose.yml:123-163) as
+    process supervision.
+
+    Restart semantics differ from the single-host loop on purpose: the
+    collective runtime cannot heal per-process (a lost member poisons
+    the collective stream — parallel/spmd.py), so ANY runtime-member
+    death tears down and relaunches the WHOLE group, exactly like Spark
+    restarting an application that lost executors. The store survives
+    group restarts (it is outside the runtime).
+
+    Cross-machine deployments run this same supervisor per machine:
+    the coordinator machine with ``LO_WORKERS=0`` workers here and
+    remote workers joining via ``LO_COORDINATOR``/``LO_PROCESS_ID`` —
+    see deploy/README.md.
+    """
+    store.start()
+    store_live_port = store.wait_port(60)
+    store_url = f"http://{host}:{store_live_port}"
+    wait_health(store_url, 60)
+    log(f"[stack] store healthy at {store_url}")
+
+    coord_port = os.environ.get("LO_COORD_PORT", "12355")
+    num_processes = workers + 1
+
+    def runtime_env(process_id: int) -> dict:
+        env = dict(base_env)
+        env["LO_STORE_URL"] = store_url
+        env["LO_COORDINATOR"] = f"{host}:{coord_port}"
+        env["LO_NUM_PROCESSES"] = str(num_processes)
+        env["LO_PROCESS_ID"] = str(process_id)
+        # checkpoints must land on a path every host shares; on one
+        # machine the data dir IS that shared volume
+        env.setdefault("LO_MODELS_DIR", os.path.join(data_dir, "models"))
+        if ephemeral:
+            env["LO_EPHEMERAL"] = "1"
+        env.pop("LO_SERVICE", None)  # coordinator runs all-in-one
+        return env
+
+    group_names = ["coordinator"] + [f"worker{i}" for i in range(1, num_processes)]
+    group_restarts = 0
+
+    def launch_group() -> None:
+        # A bring-up can stall (e.g. a member hitting a stale
+        # coordination socket); retry the whole group like any other
+        # restart instead of giving up the stack.
+        for attempt in range(3):
+            for index, name in enumerate(group_names):
+                child = Child(
+                    name,
+                    [sys.executable, "-m", "learningorchestra_tpu.services.runner"],
+                    runtime_env(index),
+                    log,
+                )
+                children[name] = child
+                child.start()
+            try:
+                children["coordinator"].wait_port(180)
+                # the all-in-one coordinator announces one port PER
+                # service; wait for the full set before publishing
+                deadline = time.time() + 60
+                while (
+                    len(children["coordinator"].service_ports) < len(SERVICE_NAMES)
+                    and time.time() < deadline
+                ):
+                    time.sleep(0.2)
+                if len(children["coordinator"].service_ports) < len(SERVICE_NAMES):
+                    raise TimeoutError(
+                        "coordinator announced only "
+                        f"{sorted(children['coordinator'].service_ports)}"
+                    )
+                for name in group_names[1:]:
+                    children[name].wait_ready(180)
+            except TimeoutError as error:
+                if attempt == 2:
+                    raise
+                log(f"[stack] group bring-up stalled ({error}); relaunching")
+                stop_group()
+                time.sleep(restart_delay)
+                continue
+            break
+        write_ports()
+        log(
+            f"[stack] runtime up: coordinator + {workers} worker(s), "
+            f"ports in {ports_path}"
+        )
+
+    def stop_group() -> None:
+        for name in group_names:
+            child = children.get(name)
+            if child is None:
+                continue
+            child.terminate()
+            if child.proc is not None:
+                try:
+                    child.proc.wait(10)
+                except subprocess.TimeoutExpired:
+                    child.proc.kill()
+
+    launch_group()
+
+    exit_code = 0
+    retired: set = set()
+    while not stopping.is_set():
+        time.sleep(0.5)
+        store_code = store.poll()
+        if (
+            store_code is not None
+            and store_code != 0
+            and "store" not in retired
+            and not stopping.is_set()
+        ):
+            if max_restarts is not None and store.restarts >= max_restarts:
+                log(
+                    f"[stack] store failed (rc={store_code}) after "
+                    f"{store.restarts} restarts; giving up"
+                )
+                exit_code = 1
+                break
+            store.restarts += 1
+            log(f"[stack] store failed (rc={store_code}); restarting")
+            time.sleep(restart_delay)
+            store._port_event.clear()
+            store.start()
+            new_port = store.wait_port(60)
+            new_url = f"http://{host}:{new_port}"
+            wait_health(new_url, 60)
+            if new_url != store_url:
+                # ephemeral store port moved: the group's LO_STORE_URL
+                # is stale — rewire by restarting the runtime group
+                log(f"[stack] store moved to {new_url}; restarting group")
+                store_url = new_url
+                stop_group()
+                launch_group()
+            write_ports()
+        elif store_code == 0 and "store" not in retired:
+            log("[stack] store exited cleanly; not restarting")
+            retired.add("store")
+            store.port = None
+            write_ports()
+        dead = [
+            name
+            for name in group_names
+            if children[name].poll() is not None
+        ]
+        if dead and not stopping.is_set():
+            if max_restarts is not None and group_restarts >= max_restarts:
+                log(
+                    f"[stack] runtime member(s) {dead} died after "
+                    f"{group_restarts} group restarts; giving up"
+                )
+                exit_code = 1
+                break
+            group_restarts += 1
+            log(
+                f"[stack] runtime member(s) {dead} died — a lost member "
+                "poisons the collective stream; restarting the WHOLE "
+                f"group (#{group_restarts}) in {restart_delay}s"
+            )
+            stop_group()
+            time.sleep(restart_delay)
+            launch_group()
 
     return exit_code
 

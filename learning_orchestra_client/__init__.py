@@ -1,0 +1,37 @@
+"""Drop-in replacement for the reference's PyPI client package.
+
+The reference ships `learning-orchestra-client` (reference:
+learning_orchestra_client/setup.py:1-22, __init__.py:1-370); user
+scripts begin with ``from learning_orchestra_client import *`` and use
+``Context`` plus the per-service classes. This shim re-exports the
+byte-compatible client (learningorchestra_tpu/client.py — same class
+names, banners, ports, poll loop, including the reference's
+``AsyncronousWait``/``READE`` spellings), so the documented walkthrough
+runs against the TPU framework with only the cluster IP changed.
+"""
+
+from learningorchestra_tpu.client import (  # noqa: F401
+    AsyncronousWait,
+    Context,
+    DatabaseApi,
+    DataTypeHandler,
+    Histogram,
+    Model,
+    Pca,
+    Projection,
+    ResponseTreat,
+    Tsne,
+)
+
+__all__ = [
+    "AsyncronousWait",
+    "Context",
+    "DatabaseApi",
+    "DataTypeHandler",
+    "Histogram",
+    "Model",
+    "Pca",
+    "Projection",
+    "ResponseTreat",
+    "Tsne",
+]

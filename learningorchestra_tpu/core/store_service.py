@@ -148,11 +148,21 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
 
     @app.route("/promote", methods=("POST",))
     def promote(request):
+        """Flip this follower writable. The response reports the last
+        WAL position applied from the old primary so the operator can
+        see the acknowledged replication lag (records the dead primary
+        accepted but never shipped are LOST — durability follows the
+        new primary from here). Fencing the OLD primary is the
+        operator's step: if it revives, restart it with LO_PRIMARY_URL
+        pointing at the new primary so it rejoins as a follower instead
+        of coming back writable (deploy/README.md)."""
         poller = role.get("poller")
+        applied = None
         if poller is not None:
             poller.stop()
+            applied = {"epoch": poller.epoch, "offset": poller.offset}
         role["writable"] = True
-        return {"promoted": True}, 200
+        return {"promoted": True, "applied_through": applied}, 200
 
     @app.route("/collections", methods=("GET",))
     def list_collections(request):
@@ -772,10 +782,15 @@ def serve(
     server.store = store
     server.store_role = role
     server.replication = role["poller"]
-    if replicate and primary_url is None:
-        # The replication feed duplicates the write history in RAM;
-        # compact when it grows past LO_COMPACT_RECORDS (the snapshot
-        # replaces the history, epoch bump resyncs the followers).
+    if replicate or primary_url is not None:
+        # The replication feed duplicates the write history in RAM —
+        # on the primary AND on every follower (a follower re-logs each
+        # applied record so it is promotable with full durability).
+        # Compact when it grows past LO_COMPACT_RECORDS: the snapshot
+        # replaces the history; on the primary the epoch bump resyncs
+        # followers, on a follower compaction is purely local (the
+        # poller's cursor tracks the PRIMARY's epoch, not the local
+        # one), and a follower promoted later keeps compacting.
         threshold = int(os.environ.get("LO_COMPACT_RECORDS", "200000"))
         stop = threading.Event()
 

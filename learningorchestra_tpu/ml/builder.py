@@ -116,18 +116,31 @@ def train_one(
         model = classifier.fit(X_train, y_train)
     metadata["fit_time"] = timer.timings["fit"]
 
-    models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
-    if models_dir and write_outputs:
+    # None = "no caller preference" → env fallback; "" = explicitly
+    # disabled. The distinction matters on a multi-host mesh: the SPMD
+    # payload carries one resolved value to every process, so whether
+    # the (collective) checkpoint gather runs is decided identically
+    # everywhere — a per-host env fallback on "" would desynchronize.
+    if models_dir is None:
+        models_dir = os.environ.get("LO_MODELS_DIR")
+    if models_dir:
         from learningorchestra_tpu.ml.checkpoint import (
             checkpoint_path,
-            save_model,
+            gather_model,
+            write_checkpoint,
         )
 
-        os.makedirs(models_dir, exist_ok=True)
         artifact = checkpoint_path(models_dir, output_name)
         with timer.phase("checkpoint"):
-            save_model(model, artifact)
-        metadata["model_checkpoint"] = artifact
+            # the gather may be a cross-host collective (model-axis
+            # sharded params): ALL processes enter it; only the
+            # coordinator touches the filesystem
+            gathered = gather_model(model)
+            if write_outputs:
+                os.makedirs(models_dir, exist_ok=True)
+                write_checkpoint(gathered, artifact)
+        if write_outputs:
+            metadata["model_checkpoint"] = artifact
 
     if features_evaluation is not None:
         # Sharded once, shared across all classifier threads (cached on

@@ -35,34 +35,46 @@ def checkpoint_path(models_dir: str, name: str) -> str:
     return os.path.join(models_dir, name + CHECKPOINT_SUFFIX)
 
 
+def _fetch(value) -> np.ndarray:
+    """Device → host for a (possibly multi-host-sharded) parameter.
+
+    ``np.asarray`` raises on arrays spanning non-addressable devices
+    (model-axis-sharded LR classes / RF trees on a multi-host mesh);
+    ``parallel.multihost.fetch`` process_allgathers those — and every
+    process enters train_one, so the collective lines up."""
+    from learningorchestra_tpu.parallel.multihost import fetch
+
+    return np.asarray(fetch(value))
+
+
 def _arrays_of(model) -> tuple[str, dict[str, np.ndarray], dict]:
     if isinstance(model, LogisticRegressionModel):
         return (
             "logistic",
             {
-                "w": np.asarray(model.params["w"]),
-                "b": np.asarray(model.params["b"]),
-                "mean": np.asarray(model.mean),
-                "scale": np.asarray(model.scale),
+                "w": _fetch(model.params["w"]),
+                "b": _fetch(model.params["b"]),
+                "mean": _fetch(model.mean),
+                "scale": _fetch(model.scale),
             },
             {},
         )
     if isinstance(model, NaiveBayesModel):
         return (
             "naive_bayes",
-            {"theta": np.asarray(model.theta), "prior": np.asarray(model.prior)},
+            {"theta": _fetch(model.theta), "prior": _fetch(model.prior)},
             {},
         )
     if isinstance(model, GBTModel):
         return (
             "gbt",
             {
-                "features_heap": np.asarray(model.features_heap),
-                "thresholds_heap": np.asarray(model.thresholds_heap),
-                "leaf_values": np.asarray(model.leaf_values),
+                "features_heap": _fetch(model.features_heap),
+                "thresholds_heap": _fetch(model.thresholds_heap),
+                "leaf_values": _fetch(model.leaf_values),
             },
             {
-                "f0": float(np.asarray(model.f0)),
+                "f0": float(_fetch(model.f0)),
                 "step": float(model.step),
                 "max_depth": int(model.max_depth),
             },
@@ -71,22 +83,34 @@ def _arrays_of(model) -> tuple[str, dict[str, np.ndarray], dict]:
         return (
             "tree_ensemble",
             {
-                "features_heap": np.asarray(model.features_heap),
-                "thresholds_heap": np.asarray(model.thresholds_heap),
-                "leaf_probs": np.asarray(model.leaf_probs),
+                "features_heap": _fetch(model.features_heap),
+                "thresholds_heap": _fetch(model.thresholds_heap),
+                "leaf_probs": _fetch(model.leaf_probs),
             },
             {"max_depth": int(model.max_depth)},
         )
     raise TypeError(f"unknown model type {type(model).__name__}")
 
 
-def save_model(model, path: str) -> None:
-    """Write a fitted model to ``path`` (.npz format, any extension).
+def gather_model(model) -> tuple[str, dict[str, np.ndarray], dict]:
+    """Fetch a fitted model's parameters to host memory.
 
-    The write is atomic (temp file + ``os.replace``): a concurrent
-    reader never sees a partial archive, and a crash mid-save never
-    leaves a corrupt artifact at the published path."""
-    kind, arrays, scalars = _arrays_of(model)
+    On a multi-host mesh with model-axis sharding this enters a
+    process_allgather, so EVERY process must call it at the same point
+    (the builder runs it on all processes; only the coordinator then
+    writes the file — parallel/spmd.py's compute-global/IO-local rule).
+    """
+    return _arrays_of(model)
+
+
+def write_checkpoint(
+    gathered: tuple[str, dict[str, np.ndarray], dict], path: str
+) -> None:
+    """Write gathered model arrays to ``path`` (.npz format, any
+    extension). The write is atomic (temp file + ``os.replace``): a
+    concurrent reader never sees a partial archive, and a crash
+    mid-save never leaves a corrupt artifact at the published path."""
+    kind, arrays, scalars = gathered
     tmp_path = path + ".tmp"
     # Write through a file object: np.savez given a *name* appends
     # ".npz", which would split the archive from the header below.
@@ -96,6 +120,12 @@ def save_model(model, path: str) -> None:
     with zipfile.ZipFile(tmp_path, "a") as archive:
         archive.writestr(_HEADER, header)
     os.replace(tmp_path, path)
+
+
+def save_model(model, path: str) -> None:
+    """Single-host convenience: :func:`gather_model` +
+    :func:`write_checkpoint` in one call."""
+    write_checkpoint(gather_model(model), path)
 
 
 def load_model(path: str, mesh: Optional[Mesh] = None):

@@ -27,14 +27,34 @@ directly.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import traceback
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
 _SHUTDOWN_OP = "__shutdown__"
+_PING_OP = "__ping__"
+
+
+class SpmdJobError(RuntimeError):
+    """A multi-host compute job failed."""
+
+
+class SpmdTimeoutError(SpmdJobError):
+    """The job did not complete within the watchdog window — the usual
+    cause is a worker process dying mid-job, leaving the coordinator
+    blocked in a cross-host collective that can never complete."""
+
+
+class SpmdRuntimePoisonedError(SpmdJobError):
+    """A previous job timed out or failed mid-collective: the collective
+    stream across processes is desynchronized and no further SPMD job
+    can run safely. Recovery = restart the runtime (the supervisor's
+    restart policy, deploy/stack.py — the analogue of Spark restarting
+    the application when executors are lost)."""
 
 
 def _broadcast_json(obj: Any = None) -> Any:
@@ -64,26 +84,120 @@ class SpmdDispatcher:
     """Routes compute jobs to every process in the multi-host runtime."""
 
     def __init__(self) -> None:
-        self._handlers: dict[str, Callable[[dict], Any]] = {}
+        self._handlers: dict[str, Callable[[dict], Any]] = {
+            _PING_OP: lambda payload: None
+        }
         self._lock = threading.Lock()
+        self._poisoned: Optional[str] = None  # reason, once broken
+        self._stop_heartbeat = threading.Event()
+
+    def start_heartbeat(self, interval: Optional[float] = None) -> None:
+        """Coordinator-side idle keepalive. A waiting worker is not
+        passively parked: its pending ``_broadcast_json`` is a live
+        collective that the transport TIMES OUT if the coordinator stays
+        idle past the collective deadline (~30 s under gloo) — the
+        worker then crashes and the supervisor restart-loops a healthy
+        deployment. A no-op ping broadcast inside that window keeps the
+        stream alive; pings also double as worker-liveness probes (a
+        dead worker fails the ping, poisoning the dispatcher early
+        instead of at the next real job)."""
+        if jax.process_count() == 1 or jax.process_index() != 0:
+            return
+        if interval is None:
+            interval = float(os.environ.get("LO_SPMD_HEARTBEAT_S", "10"))
+
+        def beat() -> None:
+            while not self._stop_heartbeat.wait(interval):
+                if self._poisoned:
+                    return
+                try:
+                    self.submit(_PING_OP, {}, timeout=max(interval * 4, 60))
+                except SpmdJobError:
+                    return  # poisoned: the supervisor owns recovery
+
+        threading.Thread(target=beat, name="spmd-heartbeat", daemon=True).start()
 
     def register(self, op: str, handler: Callable[[dict], Any]) -> None:
         self._handlers[op] = handler
 
-    def submit(self, op: str, payload: dict) -> Any:
+    def submit(
+        self, op: str, payload: dict, timeout: Optional[float] = None
+    ) -> Any:
         """Run ``op`` on all hosts; returns the coordinator's result.
 
         Only the coordinator calls this (workers sit in
         :meth:`run_worker_loop`). The lock serializes jobs so the
         broadcast order — and therefore the collective order inside the
         handlers — is identical on every process.
+
+        Failure model (the coordinator half of the worker-death story —
+        run_worker_loop documents the worker half): the job runs under a
+        watchdog (``timeout``, default ``LO_SPMD_TIMEOUT_S``, 3600 s; 0
+        disables). If a worker dies mid-job the coordinator blocks in a
+        cross-host collective that can never complete — the watchdog
+        turns that into :class:`SpmdTimeoutError` so the REST request
+        FAILS with an error payload instead of hanging forever (the
+        reference gets task retry from Spark and restart from swarm,
+        docker-compose.yml:14-15,145). After a timeout or an in-job
+        exception the dispatcher is POISONED: the collective stream is
+        desynchronized, later submits fail fast with
+        :class:`SpmdRuntimePoisonedError`, and the supervisor's restart
+        policy rebuilds the runtime.
         """
         handler = self._handlers[op]
         if jax.process_count() == 1:
             return handler(payload)
+        if timeout is None:
+            timeout = float(os.environ.get("LO_SPMD_TIMEOUT_S", "3600") or 0)
+        if self._poisoned:
+            raise SpmdRuntimePoisonedError(self._poisoned)
         with self._lock:
-            _broadcast_json({"op": op, "payload": payload})
-            return handler(payload)
+            if self._poisoned:
+                raise SpmdRuntimePoisonedError(self._poisoned)
+            if not timeout:
+                _broadcast_json({"op": op, "payload": payload})
+                try:
+                    return handler(payload)
+                except BaseException as error:
+                    # same poisoning as the watchdog path: workers die
+                    # on in-job exceptions, the stream is broken
+                    self._poisoned = (
+                        f"SPMD job {op!r} failed mid-collective: {error}"
+                    )
+                    raise
+            box: dict[str, Any] = {}
+            done = threading.Event()
+
+            def run() -> None:
+                try:
+                    # the broadcast is inside the watchdog too: with a
+                    # dead worker it can block just like the collectives
+                    _broadcast_json({"op": op, "payload": payload})
+                    box["result"] = handler(payload)
+                except BaseException as error:  # noqa: BLE001 — re-raised
+                    box["error"] = error
+                finally:
+                    done.set()
+
+            thread = threading.Thread(
+                target=run, name=f"spmd-{op}", daemon=True
+            )
+            thread.start()
+            if not done.wait(timeout):
+                self._poisoned = (
+                    f"SPMD job {op!r} timed out after {timeout:.0f}s — a "
+                    "worker likely died mid-job; the runtime must be "
+                    "restarted (supervisor restart policy)"
+                )
+                raise SpmdTimeoutError(self._poisoned)
+            if "error" in box:
+                # an exception mid-job kills the workers by design
+                # (run_worker_loop): the runtime is no longer usable
+                self._poisoned = (
+                    f"SPMD job {op!r} failed mid-collective: {box['error']}"
+                )
+                raise box["error"]
+            return box["result"]
 
     def run_worker_loop(self) -> None:
         """Worker-process main loop: execute broadcast jobs until
@@ -109,6 +223,7 @@ class SpmdDispatcher:
                 raise
 
     def shutdown_workers(self) -> None:
+        self._stop_heartbeat.set()
         if jax.process_count() > 1 and jax.process_index() == 0:
             with self._lock:
                 _broadcast_json({"op": _SHUTDOWN_OP})

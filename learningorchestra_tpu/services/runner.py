@@ -91,6 +91,10 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
     dispatcher = SpmdDispatcher()
 
     def handle_build_model(payload: dict) -> None:
+        # models_dir comes from the BROADCAST payload on every process —
+        # never from per-host env — so the decision to enter the
+        # checkpoint gather collective is identical across the mesh
+        # (write_outputs still keeps filesystem writes coordinator-only)
         build_model(
             store,
             payload["training_filename"],
@@ -98,7 +102,7 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
             payload["preprocessor_code"],
             payload["classificators_list"],
             write_outputs=coordinator,
-            models_dir=payload.get("models_dir") if coordinator else None,
+            models_dir=payload.get("models_dir"),
         )
 
     def handle_predict_model(payload: dict) -> None:
@@ -232,6 +236,16 @@ def start_all(
 
 
 def main() -> None:
+    # An explicit JAX_PLATFORMS in the deployment env is binding. Some
+    # hosts carry an accelerator-registration sitecustomize that
+    # force-overrides the jax_platforms CONFIG at interpreter start
+    # (after env capture), silently putting a remote accelerator first;
+    # re-assert the operator's choice through the config API.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     from learningorchestra_tpu.core.store_service import connect
     from learningorchestra_tpu.parallel.multihost import initialize_from_env
 
@@ -242,6 +256,14 @@ def main() -> None:
     # process per host: run the all-in-one runner (or one compute
     # service) per host, not seven LO_SERVICE processes each trying to
     # join as the same process_id.
+    print(
+        "runner starting: "
+        f"LO_SERVICE={os.environ.get('LO_SERVICE')!r} "
+        f"LO_COORDINATOR={os.environ.get('LO_COORDINATOR')!r} "
+        f"LO_PROCESS_ID={os.environ.get('LO_PROCESS_ID')!r} "
+        f"JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}",
+        flush=True,
+    )
     multi_host = initialize_from_env()
 
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
@@ -290,6 +312,9 @@ def main() -> None:
             flush=True,
         )
         dispatcher = make_dispatcher(store, images_dir)
+        # keep idle workers' pending broadcast inside the transport's
+        # collective deadline (see SpmdDispatcher.start_heartbeat)
+        dispatcher.start_heartbeat()
         if jax.process_index() > 0:
             # Worker host: no REST surface — execute the jobs the
             # coordinator broadcasts (the spark-worker role,
@@ -310,10 +335,19 @@ def main() -> None:
         servers = [server]
     else:
         _, servers = start_all(
-            store, images_dir, host, dispatcher=dispatcher, models_dir=models_dir
+            store,
+            images_dir,
+            host,
+            ephemeral=os.environ.get("LO_EPHEMERAL") == "1",
+            dispatcher=dispatcher,
+            models_dir=models_dir,
         )
+        port_names = {port: name for name, port in SERVICES.items()}
+        for server in servers:
+            name = port_names[server.canonical_port]
+            print(f"service {name} on {host}:{server.port}", flush=True)
         print(
-            f"learningorchestra_tpu serving on ports 5000-5006 (host {host}); "
+            f"learningorchestra_tpu serving all services (host {host}); "
             f"data in {data_dir}",
             flush=True,
         )

@@ -1,0 +1,93 @@
+"""Worker-death drill process (driven by tests/test_multihost.py).
+
+Runs a 2-process jax.distributed runtime (gloo over localhost). The
+coordinator submits one healthy SPMD job, then a job whose handler
+makes the WORKER die abruptly mid-job (os._exit) while the coordinator
+enters a cross-host collective — the situation a crashed host produces
+in production. Asserted from the written results: the request errors
+cleanly (watchdog timeout or a collective error — never a hang), and
+the dispatcher refuses later jobs as poisoned. Recovery phase: a fresh
+runtime (new process pair) runs the same job successfully — the
+supervisor-restart story (deploy/stack.py restart policy; the reference
+leans on swarm restart + Spark retry, docker-compose.yml:14-15,145).
+
+argv: process_id num_processes coordinator_addr out_path phase
+phase: "drill" or "recover"
+"""
+
+import json
+import os
+import sys
+
+process_id, num_processes, coordinator, out_path, phase = sys.argv[1:6]
+process_id = int(process_id)
+num_processes = int(num_processes)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=coordinator,
+    num_processes=num_processes,
+    process_id=process_id,
+)
+
+import numpy as np  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+from learningorchestra_tpu.parallel.spmd import (  # noqa: E402
+    SpmdDispatcher,
+    SpmdRuntimePoisonedError,
+    SpmdTimeoutError,
+)
+
+dispatcher = SpmdDispatcher()
+
+
+def fit(payload):
+    """A cross-host collective job (stands in for a model fit)."""
+    gathered = multihost_utils.process_allgather(
+        np.array([jax.process_index() + 1], np.int32)
+    )
+    return int(np.sum(gathered))
+
+
+def die_mid_job(payload):
+    if jax.process_index() != 0:
+        os._exit(17)  # the worker host "crashes" mid-job
+    return fit(payload)  # coordinator enters a collective missing a peer
+
+
+dispatcher.register("fit", fit)
+dispatcher.register("die", die_mid_job)
+
+if process_id != 0:
+    try:
+        dispatcher.run_worker_loop()
+    finally:
+        os._exit(0)
+
+results = {}
+results["fit_before"] = dispatcher.submit("fit", {}, timeout=60)
+
+if phase == "drill":
+    try:
+        dispatcher.submit("die", {}, timeout=8)
+        results["death_job"] = "no-error"
+    except SpmdTimeoutError:
+        results["death_job"] = "timeout"
+    except Exception as error:  # gloo may surface the dead peer itself
+        results["death_job"] = f"error:{type(error).__name__}"
+    try:
+        dispatcher.submit("fit", {}, timeout=8)
+        results["after_death"] = "no-error"
+    except SpmdRuntimePoisonedError:
+        results["after_death"] = "poisoned"
+    except Exception as error:
+        results["after_death"] = f"error:{type(error).__name__}"
+else:  # recover: healthy pair, clean shutdown
+    dispatcher.shutdown_workers()
+
+with open(out_path, "w") as handle:
+    json.dump(results, handle)
+os._exit(0)  # never attempt distributed teardown with a dead peer

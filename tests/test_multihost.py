@@ -214,3 +214,57 @@ def test_matches_single_process_fit(worker_results):
         )
         agreement = np.mean(np.asarray(result["predictions"]) == pred)
         assert agreement == 1.0
+
+
+def _run_death_phase(tmp_path, phase: str) -> dict:
+    port = _free_port()
+    out_path = str(tmp_path / f"{phase}_results.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO_ROOT, _TESTS_DIR, env.get("PYTHONPATH", "")]
+    )
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(_TESTS_DIR, "spmd_death.py"),
+                str(pid),
+                "2",
+                f"127.0.0.1:{port}",
+                out_path,
+                phase,
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=_TESTS_DIR,
+        )
+        for pid in range(2)
+    ]
+    try:
+        out, _ = procs[0].communicate(timeout=180)
+    finally:
+        for proc in procs:  # the drill leaves no clean shutdown behind
+            proc.kill()
+    assert os.path.exists(out_path), (
+        f"coordinator produced no results:\n{out.decode(errors='replace')}"
+    )
+    with open(out_path) as handle:
+        return json.load(handle)
+
+
+def test_worker_death_fails_cleanly_then_recovers(tmp_path):
+    """The fault drill VERDICT r3 asked for: kill a worker mid-fit —
+    the coordinator's request must ERROR (watchdog timeout or a
+    collective failure), never hang; subsequent jobs fail fast as
+    poisoned; and a restarted runtime (the supervisor's job,
+    deploy/stack.py) serves the same job successfully."""
+    drill = _run_death_phase(tmp_path, "drill")
+    assert drill["fit_before"] == 3  # healthy collective: 1 + 2
+    assert drill["death_job"] != "no-error", drill
+    assert drill["after_death"] in ("poisoned",), drill
+
+    recover = _run_death_phase(tmp_path, "recover")
+    assert recover["fit_before"] == 3

@@ -1,0 +1,57 @@
+"""Packaging: `pip install .` must provide the reference client's exact
+import surface (reference learning_orchestra_client/setup.py:1-22) —
+the "change only the cluster IP" compatibility contract."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.integration
+def test_pip_install_provides_reference_client_surface(tmp_path):
+    target = tmp_path / "site"
+    install = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pip",
+            "install",
+            "--quiet",
+            "--no-deps",
+            "--no-build-isolation",
+            "--target",
+            str(target),
+            _REPO_ROOT,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert install.returncode == 0, install.stderr
+
+    probe = (
+        "from learning_orchestra_client import *\n"
+        "Context('127.0.0.1')\n"
+        "for cls in (DatabaseApi, Projection, Histogram, Tsne, Pca,"
+        " DataTypeHandler, Model):\n"
+        "    cls()\n"
+        "assert DatabaseApi.DATABASE_API_PORT == '5000'\n"
+        "assert Model.MODEL_BUILDER_PORT == '5002'\n"
+        "print('client surface ok')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(target)  # ONLY the installed tree
+    run = subprocess.run(
+        [sys.executable, "-c", probe],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(tmp_path),  # not the repo: imports must resolve from site
+        timeout=120,
+    )
+    assert run.returncode == 0, run.stderr
+    assert "client surface ok" in run.stdout

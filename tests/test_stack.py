@@ -99,3 +99,154 @@ def test_stack_bringup_serve_and_restart(tmp_path):
             supervisor.wait(30)
         except subprocess.TimeoutExpired:
             supervisor.kill()
+
+
+@pytest.mark.integration
+def test_stack_multihost_build_and_worker_death(tmp_path):
+    """LO_WORKERS=1: the supervisor brings up store + coordinator + one
+    SPMD worker as ONE jax.distributed runtime, a model build runs over
+    the REST surface on the cross-process mesh, and killing the worker
+    restarts the WHOLE group (a lost member poisons the collective
+    stream) after which the next build succeeds — the swarm-restart +
+    Spark-application-restart story in one supervisor."""
+    data_dir = tmp_path / "mh_data"
+    csv_path = tmp_path / "mh.csv"
+    with open(csv_path, "w") as f:
+        f.write("f1,f2,label\n")
+        for i in range(120):
+            lab = i % 2
+            f.write(f"{lab * 2 + (i % 7) * 0.1:.3f},{-lab + (i % 5) * 0.1:.3f},{lab}\n")
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env["LO_EPHEMERAL"] = "1"
+    env["LO_STORE_PORT"] = "0"
+    env["LO_RESTART_DELAY"] = "0.5"
+    env["LO_WORKERS"] = "1"
+    env["LO_COORD_PORT"] = "0"  # replaced below — needs a real free port
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        env["LO_COORD_PORT"] = str(s.getsockname()[1])
+    supervisor = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO_ROOT, "deploy", "stack.py"),
+         str(data_dir)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=_REPO_ROOT,
+        start_new_session=True,  # one process group: no orphaned runners
+    )
+    ports_path = data_dir / "stack_ports.json"
+
+    def wait_state(min_ports: int, deadline_s: float) -> dict:
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            if supervisor.poll() is not None:
+                out = supervisor.stdout.read()
+                raise AssertionError(f"supervisor died:\n{out}")
+            if ports_path.exists():
+                state = json.loads(ports_path.read_text())
+                if len(state["ports"]) >= min_ports and "worker1" in state["pids"]:
+                    return state
+            time.sleep(0.5)
+        raise AssertionError("stack never published the runtime ports")
+
+    def post(url, body, timeout=300):
+        data = json.dumps(body).encode()
+        request = urllib.request.Request(
+            url, data=data, headers={"Content-Type": "application/json"}
+        )
+        with urllib.request.urlopen(request, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def build_once(state, name: str) -> None:
+        db = state["ports"]["database_api"]
+        mb = state["ports"]["model_builder"]
+        dt = state["ports"]["data_type_handler"]
+        status, _ = post(
+            f"http://127.0.0.1:{db}/files",
+            {"filename": name, "url": str(csv_path)},
+        )
+        assert status == 201
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            status, body = _get(
+                f"http://127.0.0.1:{db}/files/{name}?skip=0&limit=1&query={{}}"
+            )
+            if status == 200 and body["result"][0].get("finished"):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"ingest of {name} never finished")
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{dt}/fieldtypes/{name}",
+            data=json.dumps(
+                {"f1": "number", "f2": "number", "label": "number"}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="PATCH",
+        )
+        with urllib.request.urlopen(request, timeout=60) as resp:
+            assert resp.status == 200
+        pre = (
+            "from pyspark.ml.feature import VectorAssembler\n"
+            "assembler = VectorAssembler(inputCols=['f1', 'f2'],"
+            " outputCol='features')\n"
+            "features_training = assembler.transform(training_df)\n"
+            "features_testing = assembler.transform(testing_df)\n"
+            "features_evaluation = features_training\n"
+        )
+        status, _ = post(
+            f"http://127.0.0.1:{mb}/models",
+            {
+                "training_filename": name,
+                "test_filename": name,
+                "preprocessor_code": pre,
+                "classificators_list": ["lr"],
+            },
+        )
+        assert status == 201
+        status, body = _get(
+            f"http://127.0.0.1:{db}/files/{name}_prediction_lr"
+            "?skip=0&limit=1&query={}"
+        )
+        assert status == 200
+        assert float(body["result"][0]["accuracy"]) > 0.8
+
+    try:
+        state = wait_state(8, 420)
+        build_once(state, "mh_a")
+
+        # kill the worker: the whole runtime group must restart
+        os.kill(state["pids"]["worker1"], signal.SIGKILL)
+        old_coord_pid = state["pids"]["coordinator"]
+        deadline = time.time() + 420
+        while time.time() < deadline:
+            fresh = wait_state(8, 420)
+            if fresh["pids"]["coordinator"] != old_coord_pid:
+                state = fresh
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("group never restarted after worker death")
+
+        build_once(state, "mh_b")
+    finally:
+        supervisor.send_signal(signal.SIGTERM)
+        try:
+            out, _ = supervisor.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            supervisor.kill()
+            out, _ = supervisor.communicate()
+        # a supervisor killed mid-bring-up can leave runner children
+        # behind; sweep the whole process group
+        try:
+            os.killpg(supervisor.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
