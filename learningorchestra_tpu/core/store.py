@@ -578,6 +578,14 @@ class InMemoryStore(DocumentStore):
         # snapshot IS the new log prefix).
         self._wal_buffer: Optional[list[str]] = [] if replicate else None
         self._wal_epoch = 0
+        # During a compaction, mutations are additionally captured here
+        # so the snapshot being written can be completed with the
+        # records that landed while it was serialized (see compact()).
+        self._compact_side: Optional[list[str]] = None
+        # Bumped by resync_apply: an in-flight compaction whose
+        # generation no longer matches must ABANDON — its snapshot
+        # predates the resync and publishing it would revert the log.
+        self._compact_gen = 0
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
             wal_path = os.path.join(data_dir, "wal.jsonl")
@@ -598,6 +606,8 @@ class InMemoryStore(DocumentStore):
             self._wal.flush()
         if self._wal_buffer is not None:
             self._wal_buffer.append(line)
+        if self._compact_side is not None:
+            self._compact_side.append(line)
 
     def _replay(self, wal_path: str) -> None:
         with open(wal_path, encoding="utf-8") as handle:
@@ -720,6 +730,12 @@ class InMemoryStore(DocumentStore):
         point leaves either the old replica state or the new snapshot,
         never nothing."""
         with self._lock:
+            # Invalidate any in-flight compaction: its snapshot views
+            # predate this resync and MUST NOT be published over the
+            # resynced log (compact() checks the generation before its
+            # buffer/file swaps and abandons).
+            self._compact_gen += 1
+            self._compact_side = None
             if self._wal is not None:
                 path = self._wal.name
                 tmp_path = path + ".resync.tmp"
@@ -738,69 +754,134 @@ class InMemoryStore(DocumentStore):
             for line in lines:
                 self._apply_record(json.loads(line))
 
-    def compact(self) -> None:
-        """Rewrite the WAL as a snapshot.
+    def compact(self) -> bool:
+        """Rewrite the WAL as a snapshot — WITHOUT stalling the world.
+        Returns True when THIS call durably published a snapshot; False
+        when it was skipped (another compaction in flight) or abandoned
+        (a replication resync superseded the snapshot mid-write) —
+        callers that need an on-return durability guarantee must check.
+
+        The expensive work (serializing every block to base64 lines,
+        writing + fsyncing the snapshot file) happens OUTSIDE the store
+        lock against copy-on-write column snapshots; concurrent
+        mutations keep flowing and are captured on a side log
+        (``_compact_side``) that completes the snapshot before the
+        atomic rename. The lock is held only for O(collections)
+        snapshotting and list swaps — at 100M rows the old
+        serialize-under-lock design was a multi-second outage for every
+        reader and writer.
 
         Crash-safe: the snapshot is written to a temp file and
-        ``os.replace``d over ``wal.jsonl``, so a failed compaction leaves
-        the old log intact. Typed blocks serialize as base64 buffer
-        records — null masks and missing-pad masks ride along explicitly
-        (JSON has no missing/null distinction to round-trip).
+        ``os.replace``d over ``wal.jsonl`` only after its suffix is
+        fsynced, so a failure at any point leaves the old log intact.
+        Typed blocks serialize as base64 buffer records — null masks and
+        missing-pad masks ride along explicitly (JSON has no
+        missing/null distinction to round-trip).
         """
+        # Phase A (locked, O(collections)): consistent snapshot views +
+        # start capturing concurrent mutations.
         with self._lock:
             if self._wal is None and self._wal_buffer is None:
-                return
-            # Serialize the snapshot ONCE; the same lines become the new
-            # in-memory feed and the new log file. The snapshot opens
-            # with an epoch record so the log carries its own identity
-            # across restarts — a follower cursor from a previous epoch
-            # must never validate against the rewritten log.
+                return False
+            if self._compact_side is not None:
+                return False  # a compaction is already in flight
+            views = {
+                name: col.snapshot()
+                for name, col in self._collections.items()
+            }
+            self._compact_side = []
+            gen = self._compact_gen
+
+        # Phase B (unlocked): the expensive serialization.
+        try:
+            body = [
+                json.dumps(record)
+                for record in self._snapshot_records_of(views)
+            ]
+        except BaseException:
+            with self._lock:
+                self._compact_side = None
+            raise
+
+        # Phase C (locked, O(1)-ish): freeze the new log identity. The
+        # epoch record + body + captured suffix ARE the new log; the
+        # in-memory feed switches now (followers on the old epoch
+        # resync via wal_feed), while capture continues for the records
+        # that land during the file write.
+        with self._lock:
+            if self._compact_gen != gen:
+                return False  # a resync superseded this snapshot
             new_epoch = self._wal_epoch + 1
             lines = [json.dumps({"op": "epoch", "e": new_epoch})]
-            lines.extend(
-                json.dumps(record) for record in self._snapshot_records()
-            )
+            lines.extend(body)
+            lines.extend(self._compact_side)
+            self._compact_side = []
             if self._wal_buffer is not None:
-                # Replication: the compacted snapshot becomes the new log
-                # prefix under the fresh epoch; followers on the old
-                # epoch resync (wal_feed).
                 self._wal_buffer[:] = lines
             self._wal_epoch = new_epoch
             if self._wal is None:
-                return
+                self._compact_side = None
+                return True
             path = self._wal.name
-            tmp_path = path + ".compact.tmp"
-            try:
-                with open(tmp_path, "w", encoding="utf-8") as handle:
-                    for line in lines:
-                        handle.write(line + "\n")
-                    handle.flush()
-                    os.fsync(handle.fileno())  # data durable before rename
-            except BaseException:
-                try:
-                    os.remove(tmp_path)
-                except OSError:
-                    pass
-                raise
-            self._wal.close()
-            try:
-                os.replace(tmp_path, path)
-                directory_fd = os.open(
-                    os.path.dirname(path) or ".", os.O_RDONLY
-                )
-                try:
-                    os.fsync(directory_fd)  # make the rename itself durable
-                finally:
-                    os.close(directory_fd)
-            finally:
-                # Reopen whichever file now lives at `path` so later
-                # writes never hit a closed handle.
-                self._wal = open(path, "a", encoding="utf-8")
 
-    def _snapshot_records(self) -> Iterator[dict]:
-        """The current state as a minimal WAL record sequence — the body
-        of a compacted log (and, under replication, of a new epoch)."""
-        for name, col in self._collections.items():
+        # Phase D (unlocked): write + fsync the snapshot file.
+        tmp_path = path + ".compact.tmp"
+        try:
+            with open(tmp_path, "w", encoding="utf-8") as handle:
+                for line in lines:
+                    handle.write(line + "\n")
+                handle.flush()
+                os.fsync(handle.fileno())  # data durable before rename
+
+            # Phase E (locked): drain the last captured suffix into the
+            # snapshot file, then atomically publish it.
+            with self._lock:
+                if self._compact_gen != gen:
+                    # resync landed during the file write: ITS log is
+                    # the truth now — discard this snapshot entirely
+                    try:
+                        os.remove(tmp_path)
+                    except OSError:
+                        pass
+                    return False
+                side = self._compact_side or []
+                self._compact_side = None
+                if side:
+                    with open(tmp_path, "a", encoding="utf-8") as handle:
+                        for line in side:
+                            handle.write(line + "\n")
+                        handle.flush()
+                        os.fsync(handle.fileno())
+                self._wal.close()
+                try:
+                    os.replace(tmp_path, path)
+                    directory_fd = os.open(
+                        os.path.dirname(path) or ".", os.O_RDONLY
+                    )
+                    try:
+                        os.fsync(directory_fd)  # make the rename durable
+                    finally:
+                        os.close(directory_fd)
+                finally:
+                    # Reopen whichever file now lives at `path` so later
+                    # writes never hit a closed handle.
+                    self._wal = open(path, "a", encoding="utf-8")
+        except BaseException:
+            with self._lock:
+                self._compact_side = None
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+            raise
+        return True
+
+    def _snapshot_records_of(
+        self, collections: dict[str, "_Collection"]
+    ) -> Iterator[dict]:
+        """State as a minimal WAL record sequence — the body of a
+        compacted log (and, under replication, of a new epoch)."""
+        for name, col in collections.items():
             yield {"op": "create", "c": name}
             if col.block_columns:
                 yield {
@@ -814,6 +895,9 @@ class InMemoryStore(DocumentStore):
                 }
             if col.rows:
                 yield {"op": "insert_many", "c": name, "d": list(col.rows.values())}
+
+    def _snapshot_records(self) -> Iterator[dict]:
+        return self._snapshot_records_of(self._collections)
 
     # --- primitive ops (no locking/logging) -----------------------------------
     def _apply_insert(self, collection: str, document: dict) -> None:

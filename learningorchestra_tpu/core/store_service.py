@@ -143,8 +143,11 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     def compact(request):
         if not hasattr(store, "compact"):
             return {"error": "store does not support compaction"}, 404
-        store.compact()
-        return {"compacted": True}, 200
+        # compacted: false = skipped (another compaction in flight) or
+        # superseded by a replication resync — the caller must NOT
+        # assume the on-disk log is a fresh snapshot
+        compacted = bool(store.compact())
+        return {"compacted": compacted}, 200
 
     @app.route("/promote", methods=("POST",))
     def promote(request):

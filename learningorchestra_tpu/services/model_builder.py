@@ -41,14 +41,26 @@ def create_app(
     build=None,
     models_dir: Optional[str] = None,
     predict=None,
+    jobs: "JobManager | None" = None,
 ) -> WebApp:
     """``build``/``predict`` override how a validated request body
     becomes a build_model / predict_with_model call — the multi-host
     runner injects an SPMD dispatch (parallel/spmd.py) so every process
     enters the fit; default is the in-process call. ``models_dir``
-    (default ``LO_MODELS_DIR``) is where checkpoints live."""
+    (default ``LO_MODELS_DIR``) is where checkpoints live.
+
+    Long builds: the reference keeps ``POST /models`` synchronous (201
+    only after ALL fits, server.py:112-115) and that stays the default
+    for parity — but a request carrying ``"async": true`` returns 201
+    immediately and runs the build as a tracked job instead, so one
+    multi-minute build no longer pins a WSGI worker invisibly;
+    ``GET /jobs`` on this service reports its state
+    (PENDING/RUNNING/FINISHED/FAILED + error payload)."""
+    from learningorchestra_tpu.core.jobs import JobManager
+
     app = WebApp("model_builder")
     models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
+    jobs = jobs or JobManager()
 
     def checkpoint_path(name: str) -> str:
         return _checkpoint_path(models_dir, name)
@@ -103,8 +115,25 @@ def create_app(
                 return {
                     MESSAGE_RESULT: validators.MESSAGE_INVALID_CLASSIFICATOR
                 }, 406
+        if body.get("async"):
+            job_name = (
+                f"build:{body['test_filename']}:"
+                f"{'+'.join(body['classificators_list'])}"
+            )
+            try:
+                jobs.submit(job_name, build, body)
+            except ValueError as error:  # same job already active
+                return {MESSAGE_RESULT: str(error)}, 409
+            return {
+                MESSAGE_RESULT: MESSAGE_CREATED_FILE,
+                "job": job_name,
+            }, 201
         build(body)
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    @app.route("/jobs", methods=("GET",))
+    def read_jobs(request):
+        return {MESSAGE_RESULT: jobs.all_jobs()}, 200
 
     @app.route("/models", methods=("GET",))
     def list_models(request):

@@ -476,3 +476,106 @@ def test_ingest_csv_with_id_header_column(store, tmp_path):
     # CSV _id column discarded; row ids are always 1..N (reference parity)
     assert [r[ROW_ID] for r in rows] == [1, 2]
     assert [r["name"] for r in rows] == ["alice", "bob"]
+
+
+def test_compact_does_not_stall_or_lose_concurrent_writes(tmp_path):
+    """Compaction serializes the snapshot OUTSIDE the store lock; writes
+    landing mid-compaction are captured and survive a WAL replay —
+    and readers/writers never wait for the full serialization."""
+    import threading
+
+    data_dir = str(tmp_path / "wal")
+    store = InMemoryStore(data_dir=data_dir)
+    store.insert_one("ds", {ROW_ID: 0, "finished": True})
+    store.insert_columns("ds", {"x": list(range(50_000))})
+
+    stop = threading.Event()
+    written = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            store.insert_one("side", {ROW_ID: i, "v": i})
+            written.append(i)
+            i += 1
+
+    thread = threading.Thread(target=writer)
+    thread.start()
+    try:
+        for _ in range(5):
+            store.compact()
+    finally:
+        stop.set()
+        thread.join()
+
+    # one more write after the last compaction, then replay everything
+    store.insert_one("side", {ROW_ID: len(written), "v": -1})
+    reopened = InMemoryStore(data_dir=data_dir)
+    assert reopened.count("ds") == 50_001
+    assert reopened.count("side") == len(written) + 1
+    assert reopened.read_columns("ds", ["x"])["x"][:3] == [0, 1, 2]
+
+
+def test_compact_drop_mid_serialization_respected(tmp_path):
+    """A collection dropped WHILE the snapshot is being serialized
+    (compaction phase B, outside the lock) must stay dropped after
+    replay: the snapshot still contains the collection's records, and
+    correctness depends on the side-captured drop replaying after
+    them."""
+    data_dir = str(tmp_path / "wal2")
+    store = InMemoryStore(data_dir=data_dir)
+    store.insert_columns("keep", {"x": [1, 2, 3]})
+    store.insert_columns("gone", {"y": [4, 5]})
+
+    original = store._snapshot_records_of
+
+    def dropping_mid_stream(collections):
+        for i, record in enumerate(original(collections)):
+            if i == 0:
+                store.drop("gone")  # lands during phase B, via side capture
+            yield record
+
+    store._snapshot_records_of = dropping_mid_stream
+    try:
+        assert store.compact() is True
+    finally:
+        store._snapshot_records_of = original
+    reopened = InMemoryStore(data_dir=data_dir)
+    assert "keep" in reopened.list_collections()
+    assert "gone" not in reopened.list_collections()
+
+
+def test_compact_abandoned_when_resync_supersedes(tmp_path):
+    """A replication resync landing mid-compaction must WIN: the
+    in-flight compaction abandons (returns False) instead of publishing
+    its stale snapshot over the resynced log."""
+    import json as _json
+
+    data_dir = str(tmp_path / "wal3")
+    store = InMemoryStore(data_dir=data_dir, replicate=True)
+    store.insert_columns("old", {"x": [1, 2]})
+
+    new_lines = [
+        _json.dumps({"op": "epoch", "e": 7}),
+        _json.dumps({"op": "create", "c": "fresh"}),
+        _json.dumps({"op": "insert", "c": "fresh", "d": {ROW_ID: 1, "v": 9}}),
+    ]
+    original = store._snapshot_records_of
+
+    def resync_mid_stream(collections):
+        for i, record in enumerate(original(collections)):
+            if i == 0:
+                store.resync_apply(new_lines)  # primary resync wins
+            yield record
+
+    store._snapshot_records_of = resync_mid_stream
+    try:
+        assert store.compact() is False
+    finally:
+        store._snapshot_records_of = original
+    # durable log and memory both reflect the resync, not the snapshot
+    assert "fresh" in store.list_collections()
+    assert "old" not in store.list_collections()
+    reopened = InMemoryStore(data_dir=data_dir)
+    assert "fresh" in reopened.list_collections()
+    assert "old" not in reopened.list_collections()

@@ -480,3 +480,94 @@ class TestMalformedQueries400:
                 f"/files/titanic?limit=5&query={json.dumps(bad)}"
             )
             assert response.status_code == 400, bad
+
+
+class TestAsyncModelBuild:
+    @pytest.fixture()
+    def store_with_numeric_dataset(self, store):
+        from learningorchestra_tpu.core.table import write_columns
+
+        write_columns(
+            store,
+            "numbers",
+            {
+                "a": [float(i % 7) for i in range(240)],
+                "b": [float((i * 3) % 5) for i in range(240)],
+                "label": [float(i % 2) for i in range(240)],
+            },
+            {"filename": "numbers", "finished": True,
+             "fields": ["a", "b", "label"]},
+        )
+        return store
+
+    def test_async_build_returns_immediately_and_tracks_job(
+        self, store_with_numeric_dataset
+    ):
+        import json as _json
+        import time as _time
+
+        from learningorchestra_tpu.services import model_builder
+
+        store = store_with_numeric_dataset
+        app = model_builder.create_app(store).test_client()
+        body = {
+            "training_filename": "numbers",
+            "test_filename": "numbers",
+            "preprocessor_code": (
+                "from pyspark.ml.feature import VectorAssembler\n"
+                "assembler = VectorAssembler(inputCols=['a', 'b'],"
+                " outputCol='features')\n"
+                "features_training = assembler.transform(training_df)\n"
+                "features_testing = assembler.transform(testing_df)\n"
+                "features_evaluation = None\n"
+            ),
+            "classificators_list": ["nb"],
+            "async": True,
+        }
+        response = app.post("/models", json=body)
+        assert response.status_code == 201
+        payload = _json.loads(response.get_data())
+        job_name = payload["job"]
+
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            jobs = _json.loads(app.get("/jobs").get_data())["result"]
+            record = next(j for j in jobs if j["name"] == job_name)
+            if record["state"] in ("finished", "failed"):
+                break
+            _time.sleep(0.2)
+        else:
+            raise AssertionError(f"async build never completed: {record}")
+        assert record["state"] == "finished", record
+        assert "numbers_prediction_nb" in store.list_collections()
+
+    def test_async_build_failure_reported_in_jobs(
+        self, store_with_numeric_dataset
+    ):
+        import json as _json
+        import time as _time
+
+        from learningorchestra_tpu.services import model_builder
+
+        store = store_with_numeric_dataset
+        app = model_builder.create_app(store).test_client()
+        response = app.post(
+            "/models",
+            json={
+                "training_filename": "numbers",
+                "test_filename": "numbers",
+                "preprocessor_code": "this is not python",
+                "classificators_list": ["nb"],
+                "async": True,
+            },
+        )
+        assert response.status_code == 201
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            jobs = _json.loads(app.get("/jobs").get_data())["result"]
+            record = jobs[-1]
+            if record["state"] in ("finished", "failed"):
+                break
+            _time.sleep(0.2)
+        assert record["state"] == "failed"
+        assert record["error"]
