@@ -17,8 +17,13 @@ holds one field of a dataset block as a typed numpy buffer:
 - ``str`` — Arrow-style UTF-8 byte buffer + int64 offsets (dataset
   bodies arrive as raw strings at ingest — reference database.py:156-169
   — so string cells must be unboxed too, not just numbers)
+- ``vec`` — fixed-width float64 vectors as one ``(rows, width)`` matrix;
+  cells materialize as per-row plain lists only at document reads. The
+  probability column the model builder persists for every test row
+  (reference model_builder.py:232-247 converts Spark's probability
+  vector per row) would otherwise box millions of Python lists.
 - ``obj`` — Python-list fallback for mixed/irregular cells (document
-  overlays, probability vectors)
+  overlays, ragged vectors)
 
 ``None`` (explicit null) and *missing* (a row that predates a
 later-added field — Mongo's absent-field state) are tracked in packed
@@ -65,13 +70,18 @@ I8 = "i8"
 NUM = "num"
 BOOL = "bool"
 STR = "str"
+VEC = "vec"
 OBJ = "obj"
 
 _NUMERIC_KINDS = frozenset((F8, I8, NUM))
-_DTYPES = {F8: np.float64, I8: np.int64, NUM: np.float64, BOOL: np.bool_}
+_DTYPES = {F8: np.float64, I8: np.int64, NUM: np.float64, BOOL: np.bool_,
+           VEC: np.float64}
 
 
 def merge_kind(a: str, b: str) -> str:
+    """Width-blind kind merge; ``vec``+``vec`` of differing widths is
+    resolved to ``obj`` in ``append_column`` (widths live on the data
+    buffers, not the kind tags)."""
     if a == b:
         return a
     if a == EMPTY:
@@ -180,9 +190,12 @@ class Column:
     def __init__(self, kind: str = EMPTY):
         self.kind = kind
         self.size = 0
-        self.data: Any = [] if kind == OBJ else np.empty(
-            0, dtype=_DTYPES.get(kind, np.uint8)
-        )
+        if kind == OBJ:
+            self.data: Any = []
+        elif kind == VEC:
+            self.data = np.empty((0, 0), dtype=np.float64)
+        else:
+            self.data = np.empty(0, dtype=_DTYPES.get(kind, np.uint8))
         self.offsets: Optional[np.ndarray] = (
             np.zeros(1, dtype=np.int64) if kind == STR else None
         )
@@ -284,6 +297,22 @@ class Column:
         """Zero-conversion constructor from a typed numpy array — the
         compute-layer hand-off. float64 NaNs read back as ``None``."""
         array = np.ascontiguousarray(array)
+        if array.ndim == 2:
+            if not np.issubdtype(array.dtype, np.number):
+                return cls.from_values(array.tolist())
+            column = cls(VEC)
+            column.data = array.astype(np.float64, copy=False)
+            column.size = len(array)
+            if none is None:
+                # NaN-as-null contract (same as the f8 branch): a cell
+                # is the whole row vector, so any NaN nulls the cell —
+                # JSON has no NaN to ship the partial vector in
+                nan = np.isnan(column.data).any(axis=1)
+                if nan.any():
+                    none = nan
+            if none is not None and none.any():
+                column.none = none.astype(bool).copy()
+            return column
         if array.dtype == np.bool_:
             column = cls(BOOL)
         elif np.issubdtype(array.dtype, np.integer):
@@ -442,7 +471,10 @@ class Column:
         if len(self.data) >= need:
             return
         capacity = max(need, 2 * len(self.data), 1024)
-        grown = np.empty(capacity, dtype=self.data.dtype)
+        if self.kind == VEC:
+            grown = np.empty((capacity, self.data.shape[1]), dtype=np.float64)
+        else:
+            grown = np.empty(capacity, dtype=self.data.dtype)
         grown[: self.size] = self.data[: self.size]
         # NOTE: _shared stays set — masks/offsets may still be shared
         # with a snapshot; _own() decides per-buffer at mutation time.
@@ -461,8 +493,20 @@ class Column:
         """Append ``other``'s cells; returns the (possibly re-kinded)
         column — callers must re-assign. The store's one append path."""
         if other.kind == EMPTY and self.kind not in (EMPTY, NUM):
-            other = other._as_kind(self.kind)
+            other = other._as_kind(self.kind, width=self._vec_width())
         merged = merge_kind(self.kind, other.kind)
+        if (
+            merged == VEC
+            and self.kind == VEC
+            and other.kind == VEC
+            and self.data.shape[1] != other.data.shape[1]
+        ):
+            if other.size == 0:  # zero rows carry no width information
+                return self
+            if self.size == 0:  # adopt the first real width
+                self.data = np.empty((0, other.data.shape[1]), np.float64)
+            else:  # widths differ: vectors become ragged → boxed fallback
+                merged = OBJ
         if merged != self.kind or (merged == NUM and other.kind != NUM):
             return self._append_promoted(other, merged)
         offset = self.size
@@ -523,14 +567,17 @@ class Column:
         if merged == other.kind and self.kind == EMPTY:
             # adopt the incoming kind, keeping the pad prefix
             fresh = Column(other.kind if other.kind != EMPTY else EMPTY)
+            width = other._vec_width()
             if other.kind == STR:
                 fresh.data = np.empty(0, dtype=np.uint8)
                 fresh.offsets = np.zeros(1, dtype=np.int64)
             elif other.kind == OBJ:
                 fresh.data = []
+            elif other.kind == VEC:
+                fresh.data = np.empty((0, width), dtype=np.float64)
             else:
                 fresh.data = np.empty(0, dtype=_DTYPES.get(other.kind, np.uint8))
-            fresh = fresh.append_column(self._as_kind(other.kind))
+            fresh = fresh.append_column(self._as_kind(other.kind, width=width))
             return fresh.append_column(other)
         if merged == NUM and self.kind in _NUMERIC_KINDS:
             promoted = self._as_kind(NUM)
@@ -541,7 +588,10 @@ class Column:
         # e.g. empty incoming into typed self at same merged kind
         return self.append_column(other._as_kind(self.kind))
 
-    def _as_kind(self, kind: str) -> "Column":
+    def _vec_width(self) -> int:
+        return self.data.shape[1] if self.kind == VEC else 0
+
+    def _as_kind(self, kind: str, width: int = 0) -> "Column":
         if kind == self.kind:
             return self
         if kind == NUM and self.kind in (I8, F8, EMPTY):
@@ -577,6 +627,9 @@ class Column:
             elif kind == OBJ:
                 out.size = self.size
                 out.data = [None] * self.size
+            elif kind == VEC:
+                out.size = self.size
+                out.data = np.zeros((self.size, width), dtype=np.float64)
             else:
                 out.size = self.size
                 out.data = np.zeros(self.size, dtype=_DTYPES[kind])
@@ -607,6 +660,8 @@ class Column:
         if self.kind == STR:
             start, stop = int(self.offsets[i]), int(self.offsets[i + 1])
             return bytes(self.data[start:stop]).decode("utf-8")
+        if self.kind == VEC:
+            return self.data[i].tolist()
         value = self.data[i]
         if self.kind == NUM:
             return int(value) if self.intm is not None and self.intm[i] else float(value)
@@ -817,10 +872,19 @@ class Column:
         if self.kind == OBJ:
             counts: dict = {}
             for value in self.data[:n]:
-                key = (isinstance(value, bool), value)
+                # lists (ragged/demoted vector cells) hash as tuples
+                key = (
+                    (isinstance(value, bool), tuple(value))
+                    if isinstance(value, list)
+                    else (isinstance(value, bool), value)
+                )
                 counts[key] = counts.get(key, 0) + 1
             out = [
-                {"_id": key[1], "count": count} for key, count in counts.items()
+                {
+                    "_id": list(key[1]) if isinstance(key[1], tuple) else key[1],
+                    "count": count,
+                }
+                for key, count in counts.items()
             ]
             if null_count:
                 # nulls already appear as None entries in data; pads were
@@ -829,6 +893,22 @@ class Column:
             return out
         if self.kind == EMPTY:
             return [{"_id": None, "count": n}] if n else []
+        if self.kind == VEC:
+            data = self.data[:n]
+            if absent is not None:
+                data = data[~absent]
+            nan = np.isnan(data).any(axis=1)
+            if nan.any():  # NaN cells group as null (f8 parity)
+                null_count += int(nan.sum())
+                data = data[~nan]
+            values, counts = np.unique(data, axis=0, return_counts=True)
+            out = [
+                {"_id": row.tolist(), "count": int(count)}
+                for row, count in zip(values, counts)
+            ]
+            if null_count:
+                out.append({"_id": None, "count": null_count})
+            return out
         if self.kind == STR:
             source = self._materialized()
             values = source._decode_all()
@@ -895,6 +975,10 @@ class Column:
             buffers.append(np.ascontiguousarray(source.offsets[: n + 1]).tobytes())
             meta["data"] = True
             meta["offsets"] = True
+        elif source.kind == VEC:
+            meta["w"] = source.data.shape[1]
+            buffers.append(np.ascontiguousarray(source.data[:n]).tobytes())
+            meta["data"] = True
         elif source.kind != EMPTY:
             buffers.append(np.ascontiguousarray(source.data[:n]).tobytes())
             meta["data"] = True
@@ -928,6 +1012,14 @@ class Column:
         elif kind == STR:
             column.data = np.frombuffer(take(), dtype=np.uint8).copy()
             column.offsets = np.frombuffer(take(), dtype=np.int64).copy()
+        elif kind == VEC:
+            width = int(meta["w"])
+            raw = np.frombuffer(take(), dtype=np.float64).copy()
+            column.data = (
+                raw.reshape(-1, width)
+                if width
+                else np.empty((n, 0), dtype=np.float64)
+            )
         elif kind == EMPTY:
             column.data = np.zeros(n, dtype=np.uint8)
         else:
@@ -948,6 +1040,8 @@ class Column:
         record = {"k": meta["kind"], "n": meta["n"]}
         if "values" in meta:
             record["v"] = meta["values"]
+        if "w" in meta:
+            record["w"] = meta["w"]
         index = 0
         for key, flag in (
             ("d", "data"),
@@ -966,6 +1060,8 @@ class Column:
         meta = {"kind": record["k"], "n": record["n"]}
         if "v" in record:
             meta["values"] = record["v"]
+        if "w" in record:
+            meta["w"] = record["w"]
         buffers: list[bytes] = []
         for key, flag in (
             ("d", "data"),

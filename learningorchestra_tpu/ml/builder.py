@@ -50,19 +50,21 @@ def _prediction_columns(predicted_df: DataFrame) -> dict[str, Column]:
     """Column-major view of a prediction frame as typed columns: every
     column except the assembled ``features`` vector (the reference also
     deletes ``rawPrediction``, which we never materialize),
-    ``probability`` as per-row plain lists (reference
-    model_builder.py:232-247). Numeric columns hand their buffers to the
-    store directly — no per-value float()/isnan loops (the tail the
-    reference never fixed, model_builder.py:237-247)."""
+    ``probability`` as a fixed-width ``vec`` column — the (rows, classes)
+    matrix goes to the store as one float64 buffer and materializes as
+    per-row plain lists only at document reads (reference
+    model_builder.py:232-247 boxes it per row at driver collect time).
+    Numeric columns hand their buffers to the store directly — no
+    per-value float()/isnan loops (the tail the reference never fixed,
+    model_builder.py:237-247)."""
     out: dict[str, Column] = {}
     for name in predicted_df.columns:
         if name == FEATURES_COL:
             continue
         column = predicted_df._column(name)
         if column.ndim > 1:
-            # one C-level nested tolist; rows become plain lists
-            out[name] = Column.from_values(
-                np.asarray(column, dtype=np.float64).tolist()
+            out[name] = Column.from_numpy(
+                np.asarray(column, dtype=np.float64)
             )
         elif column.dtype == object:
             out[name] = Column.from_values(column.tolist())

@@ -352,3 +352,103 @@ def test_merge_kind_lattice():
     assert merge_kind("bool", "i8") == "obj"
     assert merge_kind("str", "str") == "str"
     assert merge_kind("num", "i8") == "num"
+
+
+class TestVecKind:
+    """Fixed-width float64 vector columns — the probability matrix the
+    model builder persists per prediction collection (reference
+    model_builder.py:232-247 boxes Spark's probability vector per row;
+    vec keeps it as one (rows, width) buffer)."""
+
+    def test_from_numpy_2d(self):
+        m = np.arange(12, dtype=np.float64).reshape(6, 2)
+        col = Column.from_numpy(m)
+        assert col.kind == "vec"
+        assert col.tolist() == m.tolist()
+        assert col.get(2) == [4.0, 5.0]
+
+    def test_append_same_width_stays_vec(self):
+        m = np.ones((3, 2))
+        col = Column.from_numpy(m).append_column(Column.from_numpy(m * 2))
+        assert col.kind == "vec" and col.size == 6
+        assert col.get(3) == [2.0, 2.0]
+
+    def test_append_width_mismatch_demotes_to_obj(self):
+        col = Column.from_numpy(np.ones((2, 2)))
+        col = col.append_column(Column.from_numpy(np.ones((2, 3))))
+        assert col.kind == "obj"
+        assert col.get(2) == [1.0, 1.0, 1.0]
+
+    def test_pads_then_vec_adopts_width(self):
+        col = Column.pads(3).append_column(
+            Column.from_numpy(np.arange(4.0).reshape(2, 2))
+        )
+        assert col.kind == "vec" and col.size == 5
+        assert col.get(0) is MISSING
+        assert col.get(3) == [0.0, 1.0]
+
+    def test_vec_then_pads(self):
+        col = Column.from_numpy(np.ones((2, 2))).append_pads(2)
+        assert col.kind == "vec" and col.size == 4
+        assert col.tolist() == [[1.0, 1.0], [1.0, 1.0], None, None]
+
+    def test_wire_and_wal_roundtrip(self):
+        m = np.random.default_rng(3).random((5, 4))
+        col = Column.from_numpy(m).append_pads(1)
+        back = Column.from_wire_parts(*col.wire_parts())
+        assert back.kind == "vec"
+        assert back.tolist() == col.tolist()
+        back2 = Column.from_json_record(col.to_json_record())
+        assert back2.tolist() == col.tolist()
+
+    def test_unique_counts_groups_rows(self):
+        col = Column.from_numpy(
+            np.array([[1.0, 2.0], [1.0, 2.0], [3.0, 4.0]])
+        )
+        groups = {tuple(g["_id"]): g["count"] for g in col.unique_counts()}
+        assert groups == {(1.0, 2.0): 2, (3.0, 4.0): 1}
+
+    def test_point_set_scalar_demotes_to_obj(self):
+        col = Column.from_numpy(np.ones((3, 2)))
+        col = col.set(1, "oops")
+        assert col.kind == "obj"
+        assert col.get(0) == [1.0, 1.0] and col.get(1) == "oops"
+
+    def test_slice_shares_buffers(self):
+        m = np.arange(8.0).reshape(4, 2)
+        sliced = Column.from_numpy(m).slice(1, 3)
+        assert sliced.kind == "vec"
+        assert sliced.tolist() == m[1:3].tolist()
+
+    def test_snapshot_copy_on_write(self):
+        col = Column.from_numpy(np.zeros((3, 2)))
+        snap = col.snapshot()
+        col.set(0, None)  # mutates masks only; data row nulls out
+        assert snap.get(0) == [0.0, 0.0]
+        assert col.get(0) is None
+
+    def test_zero_row_width_mismatch_append_is_noop(self):
+        col = Column.from_numpy(np.ones((3, 4)))
+        col = col.append_column(Column.from_numpy(np.empty((0, 2))))
+        assert col.kind == "vec" and col.size == 3
+
+    def test_nan_rows_are_null_cells(self):
+        m = np.array([[1.0, np.nan], [1.0, 2.0]])
+        col = Column.from_numpy(m)
+        assert col.tolist() == [None, [1.0, 2.0]]
+        assert col.get(0) is None
+        groups = col.unique_counts()
+        assert {repr(g["_id"]): g["count"] for g in groups} == {
+            "[1.0, 2.0]": 1,
+            "None": 1,
+        }
+        import json
+
+        json.dumps(groups)  # no NaN tokens escape
+
+    def test_unique_counts_on_demoted_obj_lists(self):
+        col = Column.from_numpy(np.ones((2, 2)))
+        col = col.append_column(Column.from_numpy(np.ones((1, 3))))
+        assert col.kind == "obj"
+        groups = {repr(g["_id"]): g["count"] for g in col.unique_counts()}
+        assert groups == {"[1.0, 1.0]": 2, "[1.0, 1.0, 1.0]": 1}
