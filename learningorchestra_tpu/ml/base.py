@@ -14,6 +14,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -30,6 +31,56 @@ CLASSIFIER_NAMES = ("lr", "dt", "rf", "gb", "nb")
 
 def resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
     return mesh if mesh is not None else default_mesh()
+
+
+# Multiplier on every per-estimator segment budget, read ONCE at import:
+# per-request env reads could desynchronize SPMD dispatch counts across
+# a multi-host mesh, so the knob is process-lifetime constant and must
+# be set identically on every host (deploy/README.md env contract).
+_PROGRAM_BUDGET_SCALE = float(os.environ.get("LO_PROGRAM_ROW_STEPS", "1") or "1")
+
+
+def largest_divisor(total: int, cap: int, multiple_of: int = 1) -> int:
+    """Largest divisor of ``total`` that is <= ``cap`` and a multiple of
+    ``multiple_of``; falls back to ``multiple_of`` (assumed to divide
+    ``total``) when no divisor fits under the cap."""
+    best = 0
+    for candidate in range(multiple_of, total + 1, multiple_of):
+        if total % candidate == 0 and candidate <= cap:
+            best = candidate
+    return best or multiple_of
+
+
+def segment_steps(
+    total: int, rows: int, row_steps_budget: float, features: int = 16
+) -> int:
+    """Steps per device program so one XLA execution stays short.
+
+    Iterative fits (L-BFGS iterations, boosting rounds, forest trees)
+    are dispatched as a handful of medium programs instead of one long
+    one: remotely-attached chips (and any fleet runtime with an
+    execution watchdog) kill single executions that run for minutes —
+    observed as "TPU worker process crashed" at ~2 min on a tunneled
+    v5e for a 100-iteration 10M-row L-BFGS scan — and shorter programs
+    also bound how much work a preempted job loses. ``row_steps_budget``
+    is the per-program budget in row*steps at a 16-feature reference
+    width (per-step cost scales with the feature count for both matmul
+    and histogram passes, so ``features`` rescales the budget); the
+    result is the largest divisor of ``total`` within budget, so every
+    segment has the same static shape and compiles exactly once.
+    ``LO_PROGRAM_ROW_STEPS`` multiplies all budgets (e.g. raise it on
+    directly-attached chips without an execution watchdog); it is read
+    once per process so every host of a multi-host mesh computes the
+    same segmentation.
+    """
+    row_steps_budget *= _PROGRAM_BUDGET_SCALE
+    if total <= 1 or rows <= 0:
+        return max(total, 1)
+    cost_rows = rows * max(features, 1) / 16
+    target = max(1, int(row_steps_budget / cost_rows))
+    if target >= total:
+        return total
+    return largest_divisor(total, target)
 
 
 class DeviceMatrix:

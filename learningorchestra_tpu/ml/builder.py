@@ -235,10 +235,18 @@ def build_model(
     # device programs in the SAME order, and thread scheduling is not
     # deterministic across hosts — serialize the fan-out. Single-host
     # keeps the reference's thread-per-classifier shape
-    # (model_builder.py:159-175).
-    max_workers = (
-        1 if jax.process_count() > 1 else len(classificators_list) or 1
-    )
+    # (model_builder.py:159-175). LO_BUILD_WORKERS caps the fan-out:
+    # N concurrent fits hold N models' device working sets at once, and
+    # past ~1M rows per classifier that can exceed one chip's HBM (the
+    # fits are device-queue-serialized anyway, so capping costs little
+    # wall-clock; the 10M-row scale proof runs with LO_BUILD_WORKERS=1).
+    if jax.process_count() > 1:
+        max_workers = 1
+    else:
+        max_workers = len(classificators_list) or 1
+        cap = os.environ.get("LO_BUILD_WORKERS")
+        if cap:
+            max_workers = max(1, min(max_workers, int(cap)))
     # LO_TRACE_DIR: device-level tracing of the whole fan-out (fits,
     # predictions, writes) into a TensorBoard/Perfetto profile dir —
     # one timestamped capture per build, named after the test dataset.

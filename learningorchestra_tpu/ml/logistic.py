@@ -41,9 +41,7 @@ def _loss_fn(params, X, y, mask, l2):
     return data_term + 0.5 * l2 * (params["w"] ** 2).sum()
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-def _fit(params, X, y, mask, max_iter: int, l2):
-    loss = partial(_loss_fn, X=X, y=y, mask=mask, l2=l2)
+def _optimizer():
     # Backtracking (Armijo) line search instead of optax's default zoom:
     # zoom's strong-Wolfe bracketing re-evaluates loss+grad many times
     # per iteration, and on a 1M-row fit it was 94% of the wall-clock
@@ -52,12 +50,28 @@ def _fit(params, X, y, mask, max_iter: int, l2):
     # value-fn transpose uses a Python-float cotangent that trips a
     # dtype mismatch under x64 (optax linesearch.py:363), and the price
     # is just one value_and_grad per accepted step.
-    optimizer = optax.lbfgs(
+    return optax.lbfgs(
         learning_rate=1.0,
         linesearch=optax.scale_by_backtracking_linesearch(
             max_backtracking_steps=15
         ),
     )
+
+
+@jax.jit
+def _opt_init(params):
+    return _optimizer().init(params)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _fit_segment(params, opt_state, X, y, mask, iters: int, l2):
+    """``iters`` L-BFGS iterations as ONE program, optimizer state in
+    and out — chained by :func:`_fit` so arbitrarily long optimizations
+    never exceed a single execution's wall-clock budget while the
+    L-BFGS curvature memory carries across segment boundaries — the
+    same iteration sequence as the former single-scan program."""
+    loss = partial(_loss_fn, X=X, y=y, mask=mask, l2=l2)
+    optimizer = _optimizer()
     value_and_grad = jax.value_and_grad(loss)
 
     def step(carry, _):
@@ -69,10 +83,36 @@ def _fit(params, X, y, mask, max_iter: int, l2):
         params = optax.apply_updates(params, updates)
         return (params, state), value
 
-    (params, _), losses = jax.lax.scan(
-        step, (params, optimizer.init(params)), length=max_iter
+    (params, opt_state), losses = jax.lax.scan(
+        step, (params, opt_state), length=iters
     )
-    return params, losses
+    return params, opt_state, losses
+
+
+# Per-program budget in row*iterations: ~18 iterations at 10M rows
+# (~1.6 s/iteration on one tunneled v5e) keeps a segment under ~30 s.
+_LR_ROW_ITERS_BUDGET = 180e6
+
+
+def _fit(params, X, y, mask, max_iter: int, l2):
+    """L-BFGS fit in watchdog-safe segments (see base.segment_steps)."""
+    from learningorchestra_tpu.ml.base import segment_steps
+
+    if max_iter <= 0:  # MLlib allows maxIter=0: the initial model
+        return params, jnp.zeros((0,), jnp.float32)
+    iters = segment_steps(
+        max_iter, X.shape[0], _LR_ROW_ITERS_BUDGET, X.shape[1]
+    )
+    opt_state = _opt_init(params)
+    losses = []
+    for _ in range(max_iter // iters):
+        params, opt_state, segment_losses = _fit_segment(
+            params, opt_state, X, y, mask, iters, l2
+        )
+        losses.append(segment_losses)
+    return params, (
+        jnp.concatenate(losses) if len(losses) > 1 else losses[0]
+    )
 
 
 @jax.jit

@@ -312,12 +312,26 @@ class _TreeEnsembleModel(FittedModel):
 
 @partial(jax.jit, static_argnames=("max_depth",))
 def _ensemble_forward(X, features_heap, thresholds_heap, leaf_probs, max_depth):
-    def one_tree(features, thresholds, leaves):
-        leaf = _descend(X, features, thresholds, max_depth)
-        return leaves[leaf]
+    """Mean class distribution over trees, sequentially accumulated.
 
-    per_tree = jax.vmap(one_tree)(features_heap, thresholds_heap, leaf_probs)
-    return per_tree.mean(axis=0)
+    NOT a vmap over trees: that materializes a ``(trees, rows, classes)``
+    intermediate whose class-minor dimension pads to the 128-lane tile —
+    at 20 trees × 10M rows that is ~100 GB of HBM for 1.6 GB of data.
+    The scan keeps one ``(classes, rows)`` accumulator (rows minor → no
+    padding) and one tree's gather live at a time."""
+    num_classes = leaf_probs.shape[-1]
+
+    def one_tree(acc, tree):
+        features, thresholds, leaves = tree
+        leaf = _descend(X, features, thresholds, max_depth)
+        return acc + leaves.T[:, leaf], None
+
+    acc, _ = jax.lax.scan(
+        one_tree,
+        jnp.zeros((num_classes, X.shape[0]), jnp.float32),
+        (features_heap, thresholds_heap, leaf_probs),
+    )
+    return (acc / features_heap.shape[0]).T
 
 
 @partial(jax.jit, static_argnames=("num_classes", "max_depth", "max_bins"))
@@ -326,15 +340,21 @@ def _dt_fit(bins, y, weights, num_classes, max_depth, max_bins):
     return _fit_classification_tree(bins, one_hot, max_depth, max_bins)
 
 
+def _rf_specs(mesh):
+    return (
+        NamedSharding(mesh, P(MODEL_AXIS, None)),       # features heap
+        NamedSharding(mesh, P(MODEL_AXIS, None)),       # split-bin heap
+        NamedSharding(mesh, P(MODEL_AXIS, None, None)), # leaf probs
+    )
+
+
 @partial(
     jax.jit,
-    static_argnames=(
-        "num_classes", "max_depth", "max_bins", "num_trees", "subset_k", "mesh"
-    ),
+    static_argnames=("num_classes", "max_depth", "max_bins", "subset_k", "mesh"),
 )
-def _rf_fit(
-    bins, y, weights, key, num_classes, max_depth, max_bins, num_trees,
-    subset_k, mesh=None,
+def _rf_chunk(
+    bins, y, weights, keys, num_classes, max_depth, max_bins, subset_k,
+    mesh=None,
 ):
     base_one_hot = jax.nn.one_hot(y, num_classes, dtype=jnp.float32)
 
@@ -348,7 +368,6 @@ def _rf_fit(
             bins, one_hot, max_depth, max_bins, subset_key, subset_k
         )
 
-    keys = jax.random.split(key, num_trees)
     # Tensor parallelism over TREES: the vmap axis is sharded on the
     # mesh's model axis (when it divides evenly), so a (data, model)
     # mesh grows trees 2D-parallel — each device builds the histograms
@@ -356,12 +375,8 @@ def _rf_fit(
     # histograms over the data axis only. Uneven splits replicate, like
     # LR's class axis.
     specs = None
-    if mesh is not None and num_trees % model_size(mesh) == 0:
-        specs = (
-            NamedSharding(mesh, P(MODEL_AXIS, None)),       # features heap
-            NamedSharding(mesh, P(MODEL_AXIS, None)),       # split-bin heap
-            NamedSharding(mesh, P(MODEL_AXIS, None, None)), # leaf probs
-        )
+    if mesh is not None and keys.shape[0] % model_size(mesh) == 0:
+        specs = _rf_specs(mesh)
         keys = jax.lax.with_sharding_constraint(
             keys, NamedSharding(mesh, P(MODEL_AXIS))
         )
@@ -374,13 +389,79 @@ def _rf_fit(
     return out
 
 
-@partial(jax.jit, static_argnames=("max_depth", "max_bins", "rounds"))
-def _gbt_fit(bins, y, weights, max_depth, max_bins, rounds, step):
+# Per-program budget in row*trees: one bootstrap tree costs about one
+# boosting round (~0.3-0.7 s at 1M rows) — ~4 trees at 10M rows keeps a
+# segment under the execution watchdog (see base.segment_steps).
+_RF_ROW_TREES_BUDGET = 40e6
+
+# HBM cap on the vmap width: a chunk's level-histogram transients are
+# (chunk*rows_per_device, lanes) one-hots padded to the 128-lane tile
+# (~512 B/row at f32) — 20M row*trees per device ≈ 10 GB transient,
+# inside a 16 GB v5e alongside the binned matrix.
+_RF_ROW_TREES_PER_DEVICE_HBM = 20e6
+
+
+def _rf_fit(
+    bins, y, weights, key, num_classes, max_depth, max_bins, num_trees,
+    subset_k, mesh=None,
+):
+    """Forest fit in watchdog- and HBM-safe chunks of trees. Trees are
+    independent, so chunking only splits the vmap width; the key fan-out
+    matches the former single-program fit, and on a model-sharded mesh
+    the chunk width stays a multiple of the model axis so every chunk
+    keeps the 2D tree/row parallelism."""
+    from learningorchestra_tpu.ml.base import largest_divisor, segment_steps
+    from learningorchestra_tpu.parallel.mesh import data_size
+
+    if num_trees <= 0:  # empty forest: empty heaps (vmap over no keys)
+        return _rf_chunk(
+            bins, y, weights, jax.random.split(key, 0), num_classes,
+            max_depth, max_bins, subset_k, None,
+        )
+    chunk = segment_steps(
+        num_trees, bins.shape[0], _RF_ROW_TREES_BUDGET, bins.shape[1]
+    )
+    rows_per_device = bins.shape[0] // (data_size(mesh) if mesh else 1)
+    hbm_chunk = max(1, int(_RF_ROW_TREES_PER_DEVICE_HBM // max(rows_per_device, 1)))
+    if hbm_chunk < chunk:
+        chunk = largest_divisor(num_trees, hbm_chunk)
+    sharded = mesh is not None and num_trees % model_size(mesh) == 0
+    if sharded and chunk % model_size(mesh) != 0:
+        width = model_size(mesh)
+        chunk = largest_divisor(num_trees, max(chunk, width), multiple_of=width)
+    keys = jax.random.split(key, num_trees)
+    chunks = [
+        _rf_chunk(
+            bins, y, weights, keys[start : start + chunk], num_classes,
+            max_depth, max_bins, subset_k, mesh,
+        )
+        for start in range(0, num_trees, chunk)
+    ]
+    if len(chunks) == 1:
+        return chunks[0]
+    out = tuple(jnp.concatenate(parts) for parts in zip(*chunks))
+    if sharded:
+        out = tuple(
+            jax.device_put(array, spec)
+            for array, spec in zip(out, _rf_specs(mesh))
+        )
+    return out
+
+
+@jax.jit
+def _gbt_init(y, weights):
     y_f = y.astype(jnp.float32)
     n_real = jnp.maximum(weights.sum(), 1.0)
     base_rate = jnp.clip((y_f * weights).sum() / n_real, 1e-6, 1 - 1e-6)
     f0 = jnp.log(base_rate / (1 - base_rate))
-    margins = jnp.full(bins.shape[0], f0, jnp.float32)
+    return f0, jnp.full(y.shape[0], f0, jnp.float32)
+
+
+@partial(jax.jit, static_argnames=("max_depth", "max_bins", "rounds"))
+def _gbt_rounds(bins, y, weights, margins, max_depth, max_bins, rounds, step):
+    """``rounds`` boosting rounds as one program, margins in and out —
+    chained by :func:`_gbt_fit` (see base.segment_steps)."""
+    y_f = y.astype(jnp.float32)
 
     def one_round(margins, _):
         p = jax.nn.sigmoid(margins)
@@ -392,19 +473,66 @@ def _gbt_fit(bins, y, weights, max_depth, max_bins, rounds, step):
         margins = margins + step * leaf_values[leaf_of_row]
         return margins, (features, split_bins, leaf_values)
 
-    _, (features_heap, bins_heap, leaf_values) = jax.lax.scan(
+    margins, (features_heap, bins_heap, leaf_values) = jax.lax.scan(
         one_round, margins, length=rounds
     )
+    return margins, features_heap, bins_heap, leaf_values
+
+
+# Per-program budget in row*rounds: one boosting round builds a whole
+# depth-5 tree (~0.3-0.6 s at 1M rows), so ~4 rounds at 10M rows keeps
+# a segment under the execution watchdog (see base.segment_steps).
+_GB_ROW_ROUNDS_BUDGET = 40e6
+
+
+def _gbt_fit(bins, y, weights, max_depth, max_bins, rounds, step):
+    """Sequential boosting in watchdog-safe segments; the margin vector
+    carries across programs, so the round sequence matches the former
+    single-scan program."""
+    from learningorchestra_tpu.ml.base import segment_steps
+
+    f0, margins = _gbt_init(y, weights)
+    if rounds <= 0:  # zero rounds: empty heaps, base-rate-only model
+        _, features_heap, bins_heap, leaf_values = _gbt_rounds(
+            bins, y, weights, margins, max_depth, max_bins, 0, step
+        )
+        return f0, features_heap, bins_heap, leaf_values
+    chunk = segment_steps(
+        rounds, bins.shape[0], _GB_ROW_ROUNDS_BUDGET, bins.shape[1]
+    )
+    heaps = []
+    for _ in range(rounds // chunk):
+        margins, features_heap, bins_heap, leaf_values = _gbt_rounds(
+            bins, y, weights, margins, max_depth, max_bins, chunk, step
+        )
+        heaps.append((features_heap, bins_heap, leaf_values))
+    if len(heaps) == 1:
+        features_heap, bins_heap, leaf_values = heaps[0]
+    else:
+        features_heap, bins_heap, leaf_values = (
+            jnp.concatenate(parts) for parts in zip(*heaps)
+        )
     return f0, features_heap, bins_heap, leaf_values
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
 def _gbt_forward(X, f0, features_heap, thresholds_heap, leaf_values, step, max_depth):
-    def one_tree(features, thresholds, leaves):
-        return leaves[_descend(X, features, thresholds, max_depth)]
+    """Boosted margins, sequentially accumulated over rounds — like
+    :func:`_ensemble_forward`, NOT a vmap over trees: the batched
+    ``(rounds, rows)`` descend intermediates pad ~6x on TPU tile
+    boundaries (25 GB at 20×10M rows); the scan keeps one margin
+    vector and one round's gather live at a time."""
 
-    contributions = jax.vmap(one_tree)(features_heap, thresholds_heap, leaf_values)
-    margins = f0 + step * contributions.sum(axis=0)
+    def one_tree(margins, tree):
+        features, thresholds, leaves = tree
+        leaf = _descend(X, features, thresholds, max_depth)
+        return margins + step * leaves[leaf], None
+
+    margins, _ = jax.lax.scan(
+        one_tree,
+        jnp.full(X.shape[0], f0, jnp.float32),
+        (features_heap, thresholds_heap, leaf_values),
+    )
     p = jax.nn.sigmoid(margins)
     return jnp.stack([1 - p, p], axis=1)
 

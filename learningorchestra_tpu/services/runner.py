@@ -36,6 +36,16 @@ Environment:
   so exposing the stack beyond localhost must be an explicit opt-in
   (``LO_HOST=0.0.0.0``) behind whatever sandboxing the deployment adds —
   see deploy/README.md.
+- ``LO_BUILD_WORKERS`` — cap the model builder's thread-per-classifier
+  fan-out (ml/builder.py). N concurrent fits hold N device working sets;
+  past ~1M rows/classifier on one chip set 1 to stay inside HBM.
+- ``LO_PROGRAM_ROW_STEPS`` — scale the per-program row*steps budget that
+  segments long fits into short XLA executions (ml/base.segment_steps);
+  raise it on directly-attached chips with no execution watchdog.
+- ``LO_JIT_CACHE`` — persistent XLA compilation cache directory
+  (default ``<data>/jit_cache``; empty disables). Shared safely between
+  processes; turns minutes of per-process estimator compiles into
+  second-scale cache loads (utils/jitcache.py).
 """
 
 from __future__ import annotations
@@ -267,6 +277,9 @@ def main() -> None:
     multi_host = initialize_from_env()
 
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
+    from learningorchestra_tpu.utils.jitcache import enable_compile_cache
+
+    enable_compile_cache(os.path.join(data_dir, "jit_cache"))
     images_dir = os.environ.get(
         "LO_IMAGES_DIR", os.path.join(data_dir, "images")
     )

@@ -1,0 +1,40 @@
+"""Persistent XLA compilation cache.
+
+Every service process jit-compiles the same estimator programs; on a
+small-CPU host a cold tree-fit compile costs minutes of wall-clock per
+process (measured: 113 s -> 1.7 s with the cache warm on a tunneled
+v5e). The reference ships no analogue — Spark redistributes jars, but
+every request still pays JVM/codegen warmup (reference
+model_builder.py:69-92 builds a fresh SparkSession per request). JAX's
+persistent cache is keyed by program + compiler version + topology, so
+sharing the directory between processes and across restarts is safe.
+
+``LO_JIT_CACHE`` overrides the directory; empty string disables.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENABLED = False
+
+
+def enable_compile_cache(default_dir: str | None = None) -> str | None:
+    """Idempotently point JAX's persistent compilation cache at
+    ``LO_JIT_CACHE`` (or ``default_dir``). Returns the directory used,
+    or None when disabled. Call before the first jitted execution —
+    already-compiled programs are not retroactively cached."""
+    global _ENABLED
+    cache_dir = os.environ.get("LO_JIT_CACHE")
+    if cache_dir is None:
+        cache_dir = default_dir
+    if not cache_dir:
+        return None
+    if _ENABLED:
+        return cache_dir
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # default min compile time (1 s) skips trivial programs; keep it
+    _ENABLED = True
+    return cache_dir

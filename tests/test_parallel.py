@@ -57,3 +57,93 @@ def test_shard_rows_masked_reduction():
 def test_mesh_subset_of_devices():
     mesh = make_mesh(data=2, model=3)  # 6 of 8 devices
     assert mesh.shape == {"data": 2, "model": 3}
+
+
+class TestSegmentSteps:
+    """Watchdog-safe program segmentation (ml/base.segment_steps):
+    long iterative fits dispatch as several same-shaped programs so no
+    single XLA execution runs for minutes on a watchdog-guarded chip."""
+
+    def test_small_fits_stay_single_program(self):
+        from learningorchestra_tpu.ml.base import segment_steps
+
+        assert segment_steps(100, 1_000_000, 180e6) == 100
+        assert segment_steps(20, 1_000_000, 40e6) == 20
+
+    def test_large_fits_segment_to_divisors(self):
+        from learningorchestra_tpu.ml.base import segment_steps
+
+        # every segment the same static shape: result divides the total
+        assert segment_steps(100, 10_000_000, 180e6) == 10
+        assert segment_steps(20, 10_000_000, 40e6) == 4
+        assert segment_steps(97, 10_000_000, 180e6) == 1  # prime total
+
+    def test_feature_width_scales_cost(self):
+        from learningorchestra_tpu.ml.base import segment_steps
+
+        narrow = segment_steps(20, 1_000_000, 40e6, features=16)
+        wide = segment_steps(20, 1_000_000, 40e6, features=64)
+        assert narrow == 20 and wide == 10
+
+    def test_budget_scale_knob_multiplies(self, monkeypatch):
+        from learningorchestra_tpu.ml import base
+
+        # LO_PROGRAM_ROW_STEPS is a MULTIPLIER on every budget (read
+        # once at import into _PROGRAM_BUDGET_SCALE, so patch the
+        # constant): 10x budget -> 10x longer segments
+        assert base.segment_steps(100, 10_000_000, 180e6) == 10
+        monkeypatch.setattr(base, "_PROGRAM_BUDGET_SCALE", 10.0)
+        assert base.segment_steps(100, 10_000_000, 180e6) == 100
+
+    def test_largest_divisor(self):
+        from learningorchestra_tpu.ml.base import largest_divisor
+
+        assert largest_divisor(20, 7) == 5
+        assert largest_divisor(20, 20) == 20
+        assert largest_divisor(20, 7, multiple_of=2) == 4
+        assert largest_divisor(20, 1, multiple_of=2) == 2  # fallback
+        assert largest_divisor(97, 50) == 1
+
+    def test_zero_iteration_fits_return_initial_models(self):
+        # MLlib allows maxIter=0 etc.; the segmented wrappers must keep
+        # the old lax.scan(length=0) behavior instead of crashing
+        from learningorchestra_tpu.ml.logistic import LogisticRegression
+        from learningorchestra_tpu.ml.trees import GBTClassifier, RandomForestClassifier
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 4))
+        y = (X[:, 0] > 0).astype(np.int32)
+        lr = LogisticRegression(max_iter=0).fit(X, y)
+        assert np.asarray(lr.params["w"]).shape == (4, 2)
+        gb = GBTClassifier(rounds=0).fit(X, y)
+        assert gb.predict(X[:4]).shape == (4,)
+        rf = RandomForestClassifier(num_trees=0, max_depth=2).fit(X, y)
+        assert np.asarray(rf.features_heap).shape[0] == 0
+
+    def test_segmented_lr_matches_single_program(self, monkeypatch):
+        # 12 iterations in 3 segments == 12 in one program: the carried
+        # optimizer state makes segmentation invisible to the result
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.ml import logistic
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        X_dev = jnp.asarray(X)
+        y_dev = jnp.asarray(y)
+        mask = jnp.ones(64, jnp.float32)
+        params = {
+            "w": jnp.zeros((4, 2), jnp.float32),
+            "b": jnp.zeros((2,), jnp.float32),
+        }
+        single, _ = logistic._fit(params, X_dev, y_dev, mask, 12, jnp.float32(0.0))
+        # budget that yields 4-iteration segments at 64 rows x 4 features
+        monkeypatch.setattr(logistic, "_LR_ROW_ITERS_BUDGET", 64.0)
+        from learningorchestra_tpu.ml.base import segment_steps
+
+        assert segment_steps(12, 64, 64.0, features=4) == 4
+        segmented, _ = logistic._fit(params, X_dev, y_dev, mask, 12, jnp.float32(0.0))
+        np.testing.assert_allclose(
+            np.asarray(single["w"]), np.asarray(segmented["w"]), rtol=1e-5
+        )

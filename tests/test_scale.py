@@ -1,0 +1,33 @@
+"""Opt-in scale proof (VERDICT r3 missing #1: 10M+ rows end to end).
+
+Skipped by default: the full-size run belongs on the TPU bench host
+(``python scale.py``, ~10-20 min at 10M rows). Set ``LO_SCALE_TEST`` to
+a row count to run the same path inside pytest at that size, e.g.::
+
+    LO_SCALE_TEST=2000000 python -m pytest tests/test_scale.py -q
+
+The assertion set is the "done" criterion from the round-3 review: the
+dataset ingests, all five classifiers train and write predictions, and
+peak memory stays within a small multiple of the bytes actually stored
+(boxed-object storage failed this by an order of magnitude).
+"""
+
+import os
+
+import pytest
+
+
+@pytest.mark.skipif(
+    not os.environ.get("LO_SCALE_TEST"),
+    reason="set LO_SCALE_TEST=<rows> to run the scale proof",
+)
+def test_scale_end_to_end():
+    import scale
+
+    rows = int(os.environ["LO_SCALE_TEST"])
+    out = scale.run_scale(rows, ["lr", "dt", "rf", "gb", "nb"])
+    assert set(out["accuracy"]) == {"lr", "dt", "rf", "gb", "nb"}
+    for name, accuracy in out["accuracy"].items():
+        assert accuracy > 0.8, (name, accuracy)
+    # typed blocks: memory tracks stored bytes, not boxed-object count
+    assert out["rss_over_stored"] < 6, out
