@@ -244,9 +244,14 @@ def build_model(
         max_workers = 1
     else:
         max_workers = len(classificators_list) or 1
-        cap = os.environ.get("LO_BUILD_WORKERS")
+        cap = os.environ.get("LO_BUILD_WORKERS", "").strip()
         if cap:
-            max_workers = max(1, min(max_workers, int(cap)))
+            try:
+                max_workers = max(1, min(max_workers, int(cap)))
+            except ValueError:
+                raise ValueError(
+                    f"LO_BUILD_WORKERS must be an integer, got {cap!r}"
+                ) from None
     # LO_TRACE_DIR: device-level tracing of the whole fan-out (fits,
     # predictions, writes) into a TensorBoard/Perfetto profile dir —
     # one timestamped capture per build, named after the test dataset.

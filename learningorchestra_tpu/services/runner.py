@@ -279,7 +279,7 @@ def main() -> None:
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache
 
-    enable_compile_cache(os.path.join(data_dir, "jit_cache"))
+    enable_compile_cache(os.path.join(data_dir, "jit_cache"))  # data_dir may predate env read
     images_dir = os.environ.get(
         "LO_IMAGES_DIR", os.path.join(data_dir, "images")
     )

@@ -16,25 +16,34 @@ from __future__ import annotations
 
 import os
 
-_ENABLED = False
+_ACTIVE_DIR: str | None = None
 
 
 def enable_compile_cache(default_dir: str | None = None) -> str | None:
     """Idempotently point JAX's persistent compilation cache at
-    ``LO_JIT_CACHE`` (or ``default_dir``). Returns the directory used,
-    or None when disabled. Call before the first jitted execution —
-    already-compiled programs are not retroactively cached."""
-    global _ENABLED
+    ``LO_JIT_CACHE`` (or ``default_dir``, or ``<LO_DATA_DIR>/jit_cache``
+    — the same data-dir root every service derives its paths from, so
+    scripts and services share one cache). Returns the directory
+    actually configured (the FIRST enabled dir — JAX's cache pointer is
+    process-global), or None when disabled. Call before the first
+    jitted execution — already-compiled programs are not retroactively
+    cached."""
+    global _ACTIVE_DIR
+    if _ACTIVE_DIR is not None:
+        return _ACTIVE_DIR
     cache_dir = os.environ.get("LO_JIT_CACHE")
     if cache_dir is None:
         cache_dir = default_dir
+    if cache_dir is None:
+        data_dir = os.environ.get(
+            "LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data")
+        )
+        cache_dir = os.path.join(data_dir, "jit_cache")
     if not cache_dir:
         return None
-    if _ENABLED:
-        return cache_dir
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # default min compile time (1 s) skips trivial programs; keep it
-    _ENABLED = True
+    _ACTIVE_DIR = cache_dir
     return cache_dir

@@ -53,7 +53,7 @@ def run_scale(rows: int, classifiers: list[str]) -> dict:
     # One classifier's device working set at a time: five concurrent
     # 10M-row fits exceed a single chip's HBM (16 GB on v5e).
     os.environ.setdefault("LO_BUILD_WORKERS", "1")
-    enable_compile_cache(os.path.join(os.getcwd(), "lo_data", "jit_cache"))
+    enable_compile_cache()
 
     rng = np.random.default_rng(0)
     X = rng.random((rows, FEATURES), dtype=np.float32) * 20.0
@@ -118,13 +118,83 @@ def run_scale(rows: int, classifiers: list[str]) -> dict:
     }
 
 
-def main() -> None:
-    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
-    classifiers = (
-        sys.argv[2].split(",")
-        if len(sys.argv) > 2
-        else ["lr", "dt", "rf", "gb", "nb"]
+def run_northstar(rows: int) -> dict:
+    """BASELINE configs[4] on ONE chip: histogram + PCA at NYC-Taxi-class
+    row counts (the reference provisions a 64-worker Spark swarm; its
+    PCA path cannot run at all past driver RAM — toPandas() collapse,
+    reference pca.py:75-80). Ingests ``rows`` synthetic rows into the
+    typed store, runs the store's $group histogram pushdown, then the
+    device PCA; t-SNE via the landmark path as a stretch measurement."""
+    import os
+
+    from learningorchestra_tpu.core.store import InMemoryStore
+    from learningorchestra_tpu.ops.pca import pca_embedding
+    from learningorchestra_tpu.ops.tsne import tsne_embedding
+    from learningorchestra_tpu.utils.jitcache import enable_compile_cache
+
+    enable_compile_cache()
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(10, FEATURES)).astype(np.float32) * 8.0
+    labels = rng.integers(0, 10, size=rows)
+    X = (centers[labels] + rng.normal(size=(rows, FEATURES))).astype(np.float32)
+
+    store = InMemoryStore()
+    store.create_collection("taxi")
+    store.insert_one(
+        "taxi",
+        {
+            "_id": 0,
+            "filename": "taxi",
+            "finished": True,
+            "fields": [f"f{i}" for i in range(FEATURES)] + ["cluster"],
+        },
     )
+    start = time.perf_counter()
+    columns = {f"f{i}": X[:, i] for i in range(FEATURES)}
+    columns["cluster"] = labels.astype(np.int64)
+    store.insert_columns("taxi", columns)
+    ingest_s = time.perf_counter() - start
+
+    start = time.perf_counter()
+    groups = store.aggregate(
+        "taxi",
+        [{"$group": {"_id": "$cluster", "count": {"$sum": 1}}}],
+    )
+    histogram_s = time.perf_counter() - start
+
+    start = time.perf_counter()
+    embedded = pca_embedding(X)
+    pca_e2e_s = time.perf_counter() - start
+
+    out = {
+        "rows": rows,
+        "ingest_s": round(ingest_s, 2),
+        "histogram_s": round(histogram_s, 3),
+        "histogram_groups": len(groups),
+        "pca_e2e_numpy_s": round(pca_e2e_s, 2),
+        "stored_gb": round(stored_gb(store, ["taxi"]), 2),
+        "peak_rss_gb": round(_rss_gb(), 2),
+    }
+    try:
+        start = time.perf_counter()
+        embedded = tsne_embedding(X)  # landmark path past 20k rows
+        out["tsne_landmark_s"] = round(time.perf_counter() - start, 2)
+        out["tsne_shape"] = list(embedded.shape)
+    except Exception as error:  # noqa: BLE001 — stretch measurement
+        out["tsne_landmark_error"] = f"{type(error).__name__}: {error}"
+    out["peak_rss_gb"] = round(_rss_gb(), 2)
+    return out
+
+
+def main() -> None:
+    args = [a for a in sys.argv[1:] if a != "--northstar"]
+    rows = int(args[0]) if args else 10_000_000
+    if "--northstar" in sys.argv:
+        print(json.dumps(run_northstar(rows)))
+        return
+    classifiers = args[1].split(",") if len(args) > 1 else [
+        "lr", "dt", "rf", "gb", "nb"
+    ]
     print(json.dumps(run_scale(rows, classifiers)))
 
 
