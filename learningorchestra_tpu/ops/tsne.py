@@ -57,6 +57,9 @@ CHUNK = 1024
 EXACT_ROWS_LIMIT = 20_000
 LANDMARKS = 5_000
 INTERP_CHUNK = 8_192
+# Rows per _interpolate dispatch: keeps one interpolation program well
+# under remote-execution watchdogs at any n (see ml/base.segment_steps).
+_INTERP_ROWS_PER_PROGRAM = 4_000_000
 
 
 def _squared_distances(A, B):
@@ -331,18 +334,31 @@ def _tsne_landmark(
     shards = data_size(mesh)
     chunk = min(INTERP_CHUNK, -(-n // shards))
     multiple = shards * chunk
-    n_pad = -(-n // multiple) * multiple
-    X_pad = np.pad(X, ((0, n_pad - n), (0, 0)))
-    row_sharded = NamedSharding(mesh, PSpec(DATA_AXIS))
-    X_dev = jax.device_put(jnp.asarray(X_pad), row_sharded)
     replicated = NamedSharding(mesh, PSpec())
+    row_sharded = NamedSharding(mesh, PSpec(DATA_AXIS))
     L_dev = jax.device_put(jnp.asarray(L), replicated)
     Y_L_dev = jax.device_put(jnp.asarray(Y_L, np.float32), replicated)
     interp_perplexity = min(perplexity, max((m - 1) / 3.0, 1.0))
-    Y = _interpolate(
-        mesh, X_dev, L_dev, Y_L_dev, jnp.float32(interp_perplexity), chunk
-    )
-    return fetch(Y)[:n]
+
+    # Macro-batch the interpolation: one _interpolate call is ONE XLA
+    # program sequentially mapping its blocks, and at 100M rows that is
+    # a ~20-minute single execution — execution watchdogs on
+    # remotely-attached chips kill it (same constraint as
+    # ml/base.segment_steps). Fixed-size macro slices keep every
+    # program short and identical in shape (one compile); the tail
+    # slice pads with zeros and is cropped after fetch.
+    macro = max(multiple, (_INTERP_ROWS_PER_PROGRAM // multiple) * multiple)
+    outs = []
+    for start in range(0, n, macro):
+        stop = min(start + macro, n)
+        block = X[start:stop]
+        padded = np.pad(block, ((0, macro - len(block)), (0, 0)))
+        X_dev = jax.device_put(jnp.asarray(padded), row_sharded)
+        Y = _interpolate(
+            mesh, X_dev, L_dev, Y_L_dev, jnp.float32(interp_perplexity), chunk
+        )
+        outs.append(np.asarray(fetch(Y))[: len(block)])
+    return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
 
 def tsne_embedding(

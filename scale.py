@@ -125,8 +125,6 @@ def run_northstar(rows: int) -> dict:
     reference pca.py:75-80). Ingests ``rows`` synthetic rows into the
     typed store, runs the store's $group histogram pushdown, then the
     device PCA; t-SNE via the landmark path as a stretch measurement."""
-    import os
-
     from learningorchestra_tpu.core.store import InMemoryStore
     from learningorchestra_tpu.ops.pca import pca_embedding
     from learningorchestra_tpu.ops.tsne import tsne_embedding
@@ -136,7 +134,10 @@ def run_northstar(rows: int) -> dict:
     rng = np.random.default_rng(0)
     centers = rng.normal(size=(10, FEATURES)).astype(np.float32) * 8.0
     labels = rng.integers(0, 10, size=rows)
-    X = (centers[labels] + rng.normal(size=(rows, FEATURES))).astype(np.float32)
+    # float32 end to end: float64 noise intermediates would double peak
+    # RSS (~25 GB of transients for a 6.4 GB matrix at 100M rows)
+    X = centers[labels]
+    X += rng.standard_normal((rows, FEATURES), dtype=np.float32)
 
     store = InMemoryStore()
     store.create_collection("taxi")
@@ -163,7 +164,7 @@ def run_northstar(rows: int) -> dict:
     histogram_s = time.perf_counter() - start
 
     start = time.perf_counter()
-    embedded = pca_embedding(X)
+    pca_embedding(X)
     pca_e2e_s = time.perf_counter() - start
 
     out = {
