@@ -60,32 +60,40 @@ def _num_column(data: np.ndarray, none: np.ndarray) -> Column:
         column.none = none
         column.data = column.data.copy()
         column.data[none] = np.nan
-    # NaN parsed from a literal "nan" cell also reads back as null
+    # NaN parsed from a literal "nan" cell also reads back as null —
+    # including when a None/"" mask already exists (data[none] is NaN
+    # by the assignment above, so the isnan mask is a superset)
     nan = np.isnan(column.data)
-    if nan.any() and column.none is None:
+    if nan.any():
         column.none = nan
     return column
 
 
-def _strings_to_number(values: list) -> Column:
-    """Vectorized ``float()`` over raw string cells. numpy's U→f8 cast
-    is the fast path; any cell numpy's grammar rejects (e.g. ``"1_0"``,
-    which Python's ``float`` accepts) falls back to the exact per-value
-    loop so semantics match the reference's ``float(value)``."""
+def _strings_to_number(
+    values: list, empty_mask: Optional[np.ndarray] = None
+) -> Column:
+    """Vectorized ``float()`` over raw string cells: numpy's
+    list-of-str → float64 construction parses with Python ``float``
+    semantics (``"1_0"`` included) in one C loop. ``empty_mask`` (from
+    the Arrow offsets: zero-length cells) skips the per-value None/""
+    scan when the caller already knows it."""
     n = len(values)
-    none = np.zeros(n, dtype=bool)
-    filled = values
-    needs_fill = False
-    for i, v in enumerate(values):
-        if v is None or v == "":
-            none[i] = True
-            needs_fill = True
-    if needs_fill:
-        filled = ["nan" if none[i] else v for i, v in enumerate(values)]
+    if empty_mask is not None:  # caller-complete None/"" mask: no scan
+        none = empty_mask.copy()
+    else:
+        none = np.zeros(n, dtype=bool)
+        for i, v in enumerate(values):
+            if v is None or v == "":
+                none[i] = True
+    filled = (
+        ["nan" if none[i] else v for i, v in enumerate(values)]
+        if none.any()
+        else values
+    )
     try:
-        data = np.asarray(filled, dtype="U").astype(np.float64)
-    except ValueError:
-        # numpy's parse grammar is stricter than float(); fall back
+        data = np.asarray(filled, dtype=np.float64)
+    except (ValueError, TypeError):
+        # exact per-value fallback, same error surface as float(value)
         data = np.empty(n, dtype=np.float64)
         for i, v in enumerate(filled):
             data[i] = np.nan if none[i] else float(v)
@@ -130,7 +138,15 @@ def _convert_column(column: Column, field_type: str) -> Optional[Column]:
                 ),
             )
         if column.kind == "str":
-            return _strings_to_number(column.tolist())
+            # complete None/"" mask from the Arrow offsets (zero-length
+            # cells) + the null/missing masks — skips the Python scan
+            source = column._materialized()
+            n = len(source)
+            empty = np.diff(source.offsets[: n + 1]) == 0
+            absent = source._absent_mask()
+            if absent is not None:
+                empty |= absent
+            return _strings_to_number(source.tolist(), empty_mask=empty)
         return None  # obj/bool/empty: exact per-value loop
     if field_type == STRING_TYPE:
         if column.kind in ("f8", "i8", "num"):

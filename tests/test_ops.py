@@ -172,3 +172,21 @@ class TestDtypeVectorizedParity:
 
         with _pytest.raises(ValueError):
             self._roundtrip(None, ["abc", "2"], "number")
+
+
+def test_nan_cell_with_empty_cells_reads_back_null():
+    # regression: a literal "nan" cell in a column that ALSO has ""
+    # cells kept raw NaN (invalid JSON on the wire) instead of null
+    from learningorchestra_tpu.core.store import InMemoryStore
+    from learningorchestra_tpu.ops.dtype import convert_field_types
+
+    store = InMemoryStore()
+    store.create_collection("d")
+    store.insert_one(
+        "d", {"_id": 0, "filename": "d", "finished": True, "fields": ["a"]}
+    )
+    store.insert_columns("d", {"a": ["28", "2.5", "", "1_0", "nan"]})
+    convert_field_types(store, "d", {"a": "number"})
+    rows = [store.find_one("d", {"_id": i})["a"] for i in range(1, 6)]
+    assert rows == [28, 2.5, None, 10, None]
+    assert type(rows[0]) is int and type(rows[1]) is float
