@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from learningorchestra_tpu.ml.base import (
     FittedModel,
     infer_num_classes,
+    largest_divisor,
     prepare_xy,
     resolve_mesh,
 )
@@ -53,6 +54,9 @@ NUM_TREES = 20         # MLlib default numTrees (RF)
 GBT_ROUNDS = 20        # MLlib default maxIter (GBT)
 GBT_STEP = 0.1         # MLlib default stepSize
 EPS = 1e-12
+# rows*features cap per histogram feature-block: bounds the f32 bin
+# indicator transient at ~2 GB (rows*block*max_bins*4 with 32 bins)
+_HIST_BLOCK_ROW_FEATURES = 16e6
 
 
 # --------------------------------------------------------------------------
@@ -78,6 +82,7 @@ def _level_histograms(bins, node, channels, n_nodes: int, max_bins: int):
     """
     num_channels = channels.shape[1]
     num_features = bins.shape[1]
+    rows = bins.shape[0]
 
     if n_nodes * num_channels <= 64:
         node_oh = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)
@@ -85,17 +90,35 @@ def _level_histograms(bins, node, channels, n_nodes: int, max_bins: int):
             channels.shape[0], n_nodes * num_channels
         )
 
-        def per_feature_mm(bins_f):
-            bin_oh = jax.nn.one_hot(bins_f, max_bins, dtype=jnp.float32)
+        # Feature-BLOCKED contraction: one per-feature dot re-reads the
+        # (rows, nodes*K) fused matrix from HBM once per feature — 16
+        # features × 5 levels × 20 vmapped trees ≈ 400 GB of redundant
+        # traffic per forest fit at 1M rows. Contracting a block of
+        # features in ONE dot_general reads fused once per block; the
+        # bin indicator is built in (block, rows, bins) layout and
+        # contracted over rows directly (no transpose materializes).
+        # Block size is HBM-capped: the indicator transient is
+        # rows*block*max_bins*4 bytes (~2 GB cap).
+        cap = max(1, int(_HIST_BLOCK_ROW_FEATURES // max(rows, 1)))
+        block = largest_divisor(num_features, cap)
+        blocked = bins.T.reshape(num_features // block, block, rows)
+        iota = jnp.arange(max_bins, dtype=jnp.int32)
+
+        def per_block_mm(bins_fb):
+            # (block, rows, bins) exact 0/1 indicator
+            indicator = (bins_fb[:, :, None] == iota).astype(jnp.float32)
             # HIGHEST: `fused` carries arbitrary f32 gradients on the
             # boosting path; the TPU's default bf16 matmul would shift
-            # near-tie split gains (one-hot operands alone are bf16-exact,
-            # the channel side is not)
-            return jnp.dot(
-                bin_oh.T, fused, precision=jax.lax.Precision.HIGHEST
-            )                                            # (B, nodes*K)
+            # near-tie split gains (indicator operands alone are
+            # bf16-exact, the channel side is not)
+            return jax.lax.dot_general(
+                indicator,
+                fused,
+                (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+            )                                    # (block, bins, nodes*K)
 
-        hist = jax.lax.map(per_feature_mm, bins.T)       # (F, B, nodes*K)
+        hist = jax.lax.map(per_block_mm, blocked)  # (F/blk, blk, B, n*K)
         return hist.reshape(
             num_features, max_bins, n_nodes, num_channels
         ).transpose(2, 0, 1, 3)
@@ -193,12 +216,19 @@ def _select_splits(gain, subset_key, subset_k: Optional[int]):
 
 def _route(bins, node, feature, bin_index):
     """Advance each row one level down: left iff its bin <= the node's
-    split bin; ``feature = -1`` nodes send everything left."""
+    split bin; ``feature = -1`` nodes send everything left.
+
+    The per-row feature pick is an indicator dot, not a gather:
+    ``(bins * one_hot(feature)).sum(1)`` keeps the selection on the
+    VPU (measured 2.8× faster than ``take_along_axis`` for the
+    forest's 20-way batched routing — gathers serialize on TPU).
+    Exactly one indicator per row is 1, so the int8 sum is exact."""
     row_feature = feature[node]
     row_bin = bin_index[node]
-    x_bin = jnp.take_along_axis(
-        bins, jnp.maximum(row_feature, 0)[:, None], axis=1
-    )[:, 0]
+    feature_oh = jax.nn.one_hot(
+        jnp.maximum(row_feature, 0), bins.shape[1], dtype=bins.dtype
+    )
+    x_bin = (bins * feature_oh).sum(axis=1)
     go_right = (x_bin > row_bin) & (row_feature >= 0)
     return node * 2 + go_right.astype(jnp.int32)
 
@@ -269,7 +299,14 @@ def _descend(X, features_heap, thresholds_heap, max_depth):
         heap_pos = offset + node
         feature = features_heap[heap_pos]
         threshold = thresholds_heap[heap_pos]
-        x = jnp.take_along_axis(X, jnp.maximum(feature, 0)[:, None], axis=1)[:, 0]
+        # indicator select instead of take_along_axis (see _route) —
+        # a select, not a multiply: 0 * NaN would poison the sum when
+        # an UNSELECTED column holds NaN, while a selected NaN must
+        # still route right (missing-value policy)
+        picked = jnp.maximum(feature, 0)[:, None] == jnp.arange(
+            X.shape[1], dtype=jnp.int32
+        )
+        x = jnp.where(picked, X, 0.0).sum(axis=1)
         go_right = ~(x <= threshold) & (feature >= 0)
         node = node * 2 + go_right.astype(jnp.int32)
     return node
