@@ -147,3 +147,33 @@ class TestSegmentSteps:
         np.testing.assert_allclose(
             np.asarray(single["w"]), np.asarray(segmented["w"]), rtol=1e-5
         )
+
+    def test_lr_tol_stops_early_and_matches_quality(self):
+        # MLlib-parity convergence: a converged fit stops before
+        # max_iter (checked at segment granularity) with the same
+        # decision quality as the full run
+        import jax.numpy as jnp
+
+        from learningorchestra_tpu.ml import logistic
+
+        rng = np.random.default_rng(0)
+        X = (rng.normal(size=(20_000, 8))).astype(np.float32)
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.int32)
+        X_dev = jnp.asarray(X)
+        y_dev = jnp.asarray(y)
+        mask = jnp.ones(len(X), jnp.float32)
+        params = {
+            "w": jnp.zeros((8, 2), jnp.float32),
+            "b": jnp.zeros((2,), jnp.float32),
+        }
+        p_stop, losses = logistic._fit(
+            params, X_dev, y_dev, mask, 100, jnp.float32(0.0)
+        )
+        assert np.asarray(losses).shape[0] < 100  # converged early
+        p_full, losses_full = logistic._fit(
+            params, X_dev, y_dev, mask, 100, jnp.float32(0.0), tol=0.0
+        )
+        assert np.asarray(losses_full).shape[0] == 100
+        pred_stop = np.argmax(np.asarray(X @ p_stop["w"] + p_stop["b"]), 1)
+        pred_full = np.argmax(np.asarray(X @ p_full["w"] + p_full["b"]), 1)
+        assert (pred_stop == pred_full).mean() > 0.999
