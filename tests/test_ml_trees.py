@@ -163,3 +163,18 @@ class TestNaNRouting:
         nan_rows = np.isnan(X[:, 0])
         assert (pred[nan_rows] == 1).mean() > 0.95
         assert accuracy_score(y, pred) > 0.95
+
+
+def test_deep_tree_wide_level_routing():
+    # depth > 6 exercises the _indicator_lookup gather fallback (a
+    # (rows, 2^depth) indicator would dwarf the gather it replaces)
+    import numpy as np
+
+    from learningorchestra_tpu.ml.trees import DecisionTreeClassifier
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2000, 6))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0) ^ (X[:, 2] > 0.5)).astype(np.int32)
+    model = DecisionTreeClassifier(max_depth=8).fit(X, y)
+    accuracy, _ = model.evaluate(X, y)
+    assert accuracy > 0.95
