@@ -124,6 +124,20 @@ def _object_array(values: list) -> np.ndarray:
     return out
 
 
+def _group_key(value):
+    """Canonical hashable grouping key: bools tagged apart from their
+    numeric equals PER ELEMENT (so [True] and [1] stay distinct groups,
+    like the scalar path); unhashable nested cells (dicts, lists inside
+    lists come back from tolist as lists) fall back to repr."""
+    if isinstance(value, list):
+        return tuple(_group_key(element) for element in value)
+    try:
+        hash(value)
+    except TypeError:
+        return ("__unhashable__", repr(value))
+    return (isinstance(value, bool), value)
+
+
 def _pack(mask: Optional[np.ndarray], size: int) -> Optional[bytes]:
     if mask is None:
         return None
@@ -871,26 +885,18 @@ class Column:
         n = self.size
         if self.kind == OBJ:
             counts: dict = {}
+            first: dict = {}
             for value in self.data[:n]:
-                # lists (ragged/demoted vector cells) hash as tuples
-                key = (
-                    (isinstance(value, bool), tuple(value))
-                    if isinstance(value, list)
-                    else (isinstance(value, bool), value)
-                )
+                key = _group_key(value)
                 counts[key] = counts.get(key, 0) + 1
-            out = [
-                {
-                    "_id": list(key[1]) if isinstance(key[1], tuple) else key[1],
-                    "count": count,
-                }
+                if key not in first:
+                    first[key] = value
+            # nulls already appear as None entries in data; pads were
+            # stored as None too — counts are consistent already
+            return [
+                {"_id": first[key], "count": count}
                 for key, count in counts.items()
             ]
-            if null_count:
-                # nulls already appear as None entries in data; pads were
-                # stored as None too — counts are consistent already
-                pass
-            return out
         if self.kind == EMPTY:
             return [{"_id": None, "count": n}] if n else []
         if self.kind == VEC:

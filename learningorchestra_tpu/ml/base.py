@@ -37,7 +37,15 @@ def resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
 # per-request env reads could desynchronize SPMD dispatch counts across
 # a multi-host mesh, so the knob is process-lifetime constant and must
 # be set identically on every host (deploy/README.md env contract).
-_PROGRAM_BUDGET_SCALE = float(os.environ.get("LO_PROGRAM_ROW_STEPS", "1") or "1")
+try:
+    _PROGRAM_BUDGET_SCALE = float(
+        os.environ.get("LO_PROGRAM_ROW_STEPS", "1") or "1"
+    )
+except ValueError as error:
+    raise ValueError(
+        "LO_PROGRAM_ROW_STEPS must be a number, got "
+        f"{os.environ.get('LO_PROGRAM_ROW_STEPS')!r}"
+    ) from error
 
 
 def largest_divisor(total: int, cap: int, multiple_of: int = 1) -> int:

@@ -140,11 +140,18 @@ def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
         losses.append(segment_losses)
         if tol <= 0:  # explicit "run every iteration"
             continue
-        last = float(segment_losses[-1])
-        # average per-iteration improvement below tol — the segment
-        # total scales with its length, so the threshold must too
-        if previous is not None and abs(previous - last) <= (
-            tol * iters * max(abs(last), 1.0)
+        # The MOST RECENT per-iteration improvement, like Breeze's
+        # per-iteration check (a segment-endpoint delta can stop early
+        # on an oscillating objective whose endpoints happen to match).
+        # One host transfer either way: the losses come back as one
+        # array.
+        segment_host = np.asarray(segment_losses)
+        last = float(segment_host[-1])
+        before_last = (
+            float(segment_host[-2]) if len(segment_host) > 1 else previous
+        )
+        if before_last is not None and abs(before_last - last) <= (
+            tol * max(abs(last), 1.0)
         ):
             break
         previous = last

@@ -381,6 +381,8 @@ def _ensemble_forward(X, features_heap, thresholds_heap, leaf_probs, max_depth):
         jnp.zeros((num_classes, X.shape[0]), jnp.float32),
         (features_heap, thresholds_heap, leaf_probs),
     )
+    if features_heap.shape[0] == 0:  # numTrees=0: uniform, not 0/0 NaN
+        return jnp.full((X.shape[0], num_classes), 1.0 / num_classes)
     return (acc / features_heap.shape[0]).T
 
 

@@ -104,8 +104,12 @@ def host_row_range(n_rows: int, mesh: Mesh) -> tuple[int, int]:
     is clamped to ``n_rows``; padding rows are synthesized by
     :func:`shard_rows_local`, never loaded.
     """
+    from learningorchestra_tpu.parallel.sharding import padded_row_count
+
     data_size = mesh.shape[DATA_AXIS]
-    block = -(-n_rows // data_size)  # padded rows per data-axis coord
+    # padded rows per data-axis coord — the bucketed rule, so per-host
+    # feeding matches sharding.pad_rows's global shapes exactly
+    block = padded_row_count(n_rows, data_size) // data_size
     coords = _local_data_coords(mesh)
     if not coords:
         return 0, 0
@@ -126,11 +130,13 @@ def shard_rows_local(
     mirroring ``sharding.shard_rows``'s contract — the two are
     interchangeable from the estimators' point of view.
     """
+    from learningorchestra_tpu.parallel.sharding import padded_row_count
+
     local_rows = np.asarray(local_rows)
     if dtype is not None:
         local_rows = local_rows.astype(dtype)
     data_size = mesh.shape[DATA_AXIS]
-    block = -(-n_rows // data_size)
+    block = padded_row_count(n_rows, data_size) // data_size
     padded_n = block * data_size
     start, stop = host_row_range(n_rows, mesh)
     if len(local_rows) != stop - start:

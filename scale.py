@@ -96,8 +96,11 @@ def run_scale(rows: int, classifiers: list[str]) -> dict:
     outputs = [f"scale_test_prediction_{name}" for name in classifiers]
     data_gb = stored_gb(store, ["scale_train", "scale_test"] + outputs)
     peak_gb = _rss_gb()
+    from learningorchestra_tpu.utils.jitcache import cache_stats
+
     return {
         "rows": rows,
+        "jit_cache": cache_stats(),
         "classifiers": classifiers,
         "ingest_s": round(ingest_s, 2),
         "build_s": round(build_s, 2),

@@ -244,6 +244,19 @@ class TestUniqueCounts:
         by_key = {repr(g["_id"]): g["count"] for g in pairs}
         assert by_key == {"28": 2, "2.5": 1}
 
+    def test_list_cells_keep_bool_vs_one_distinct(self):
+        # [True] and [1] must group apart, mirroring the scalar
+        # bool-vs-1 parity (advisor r4: the old key tagged the list,
+        # not its elements)
+        col = Column.from_values([[True], [1], [True]])
+        groups = {repr(g["_id"]): g["count"] for g in col.unique_counts()}
+        assert groups == {"[True]": 2, "[1]": 1}
+
+    def test_nested_unhashable_cells_group_by_repr(self):
+        col = Column.from_values([[{"a": 1}], [{"a": 1}], [{"b": 2}]])
+        counts = sorted(g["count"] for g in col.unique_counts())
+        assert counts == [1, 2]
+
 
 class TestSerialization:
     @pytest.mark.parametrize(
