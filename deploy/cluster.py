@@ -1,0 +1,352 @@
+#!/usr/bin/env python3
+"""One-command multi-MACHINE bring-up: the reference's ``run.sh`` +
+``docker stack deploy`` + worker scaling (reference run.sh:8-32,
+docker-compose.yml:1-340, README.md:94 ``docker service scale
+microservice_sparkworker=N``) as a manifest-driven cluster driver.
+
+Every machine runs the same per-machine supervisor (``deploy/stack.py``);
+this driver computes the cross-machine wiring (store URL, jax
+coordinator address, per-machine process-id ranges), launches one stack
+per machine, health-gates bring-up, and — because a lost member poisons
+the whole collective runtime — relaunches EVERY machine's runtime group
+when any machine reports a death (the role swarm restart policies +
+``dockerize -wait`` play in the reference, docker-compose.yml:14-15,145).
+
+Usage::
+
+    python deploy/cluster.py up <manifest.json>      # bring up + supervise
+    python deploy/cluster.py render <manifest.json>  # print per-machine cmds
+
+Manifest (JSON)::
+
+    {
+      "repo": "/opt/learningorchestra_tpu",  # checkout path on every machine
+      "python": "python3",
+      "transport": "ssh",          # "ssh" (default) or "local" (all
+                                   # "machines" are processes on this one —
+                                   # CI and single-box smoke)
+      "head": {
+        "host": "10.0.0.1",        # address workers + clients reach it at
+        "bind": "0.0.0.0",         # LO_HOST on the head (see deploy/README.md
+                                   # before exposing model_builder)
+        "ssh": "user@10.0.0.1",
+        "data_dir": "/var/lo_data",
+        "workers": 0               # SPMD worker processes ON the head machine
+      },
+      "workers": [                 # one entry per worker machine
+        {"host": "10.0.0.2", "ssh": "user@10.0.0.2",
+         "data_dir": "/var/lo_data", "processes": 1}
+      ],
+      "models_dir": "/shared/models",  # volume mounted by ALL machines
+      "store_port": 27027,
+      "coord_port": 12355,
+      "env": {},                   # extra env for every machine
+      "restart_delay": 5,
+      "max_cluster_restarts": null # null = retry forever
+    }
+
+``render`` prints the exact per-machine command lines (env + stack.py)
+so an operator can run or inspect them by hand; ``up`` is those commands
+plus supervision. ssh transport runs ``exec`` remotely so dropping the
+ssh connection (driver exit/restart) HUPs the remote stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+DEPLOY_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(DEPLOY_DIR)
+
+HEAD_READY_MARKERS = ("[stack] runtime up", "[stack] all services up")
+WORKER_READY_MARKER = "[stack] worker group up"
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as handle:
+        manifest = json.load(handle)
+    manifest.setdefault("python", "python3")
+    manifest.setdefault("transport", "ssh")
+    manifest.setdefault("store_port", 27027)
+    manifest.setdefault("coord_port", 12355)
+    manifest.setdefault("env", {})
+    manifest.setdefault("workers", [])
+    manifest.setdefault("restart_delay", 5)
+    head = manifest.get("head")
+    if not head or "host" not in head:
+        raise SystemExit("manifest needs head.host")
+    head.setdefault("bind", "127.0.0.1")
+    head.setdefault("workers", 0)
+    head.setdefault("data_dir", "lo_data")
+    for worker in manifest["workers"]:
+        worker.setdefault("processes", 1)
+        worker.setdefault("data_dir", "lo_data")
+    if manifest["transport"] not in ("ssh", "local"):
+        raise SystemExit(f"unknown transport {manifest['transport']!r}")
+    return manifest
+
+
+def total_processes(manifest: dict) -> int:
+    return (
+        1
+        + manifest["head"]["workers"]
+        + sum(w["processes"] for w in manifest["workers"])
+    )
+
+
+def machine_plans(manifest: dict) -> list[dict]:
+    """Per-machine launch plans: name, env, ssh target, data_dir."""
+    head = manifest["head"]
+    total = total_processes(manifest)
+    store_url = f"http://{head['host']}:{manifest['store_port']}"
+    coordinator = f"{head['host']}:{manifest['coord_port']}"
+    shared = dict(manifest["env"])
+    shared["LO_TOTAL_PROCESSES"] = str(total)
+    if "models_dir" in manifest:
+        shared["LO_MODELS_DIR"] = manifest["models_dir"]
+
+    head_env = dict(shared)
+    head_env.update(
+        {
+            "LO_HOST": head["bind"],
+            "LO_STORE_PORT": str(manifest["store_port"]),
+            "LO_COORD_PORT": str(manifest["coord_port"]),
+            "LO_WORKERS": str(head["workers"]),
+            "LO_DATA_DIR": head["data_dir"],
+        }
+    )
+    plans = [
+        {
+            "name": "head",
+            "ssh": head.get("ssh"),
+            "env": head_env,
+            "ready_markers": HEAD_READY_MARKERS,
+        }
+    ]
+    next_process_id = 1 + head["workers"]
+    for index, worker in enumerate(manifest["workers"]):
+        env = dict(shared)
+        env.update(
+            {
+                "LO_PROCESS_BASE": str(next_process_id),
+                "LO_WORKERS": str(worker["processes"]),
+                "LO_COORDINATOR": coordinator,
+                "LO_STORE_URL": store_url,
+                "LO_DATA_DIR": worker["data_dir"],
+            }
+        )
+        plans.append(
+            {
+                "name": f"machine{index + 1}",
+                "ssh": worker.get("ssh"),
+                "env": env,
+                "ready_markers": (WORKER_READY_MARKER,),
+            }
+        )
+        next_process_id += worker["processes"]
+    return plans
+
+
+def plan_command(manifest: dict, plan: dict) -> list[str]:
+    """argv for one machine's stack, through the configured transport."""
+    if manifest["transport"] == "local":
+        return [sys.executable, os.path.join(DEPLOY_DIR, "stack.py")]
+    repo = manifest.get("repo", REPO_ROOT)
+    env_prefix = " ".join(
+        f"{key}={shlex.quote(value)}" for key, value in plan["env"].items()
+    )
+    remote = (
+        f"cd {shlex.quote(repo)} && exec env {env_prefix} "
+        f"{manifest['python']} deploy/stack.py"
+    )
+    target = plan["ssh"] or plan["env"].get("LO_HOST", "")
+    return ["ssh", "-o", "BatchMode=yes", target, remote]
+
+
+class Machine:
+    """One machine's supervised stack process (local or over ssh)."""
+
+    def __init__(self, manifest: dict, plan: dict, log):
+        self.manifest = manifest
+        self.plan = plan
+        self.log = log
+        self.proc: subprocess.Popen | None = None
+        self.ready = threading.Event()
+
+    def start(self) -> None:
+        self.ready.clear()
+        env = None
+        if self.manifest["transport"] == "local":
+            env = dict(os.environ)
+            env.update(self.plan["env"])
+            env["PYTHONPATH"] = (
+                REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+            )
+            env["PYTHONUNBUFFERED"] = "1"
+        self.proc = subprocess.Popen(
+            plan_command(self.manifest, self.plan),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        threading.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self) -> None:
+        proc = self.proc
+        for line in proc.stdout:
+            if any(marker in line for marker in self.plan["ready_markers"]):
+                self.ready.set()
+            self.log(f"[{self.plan['name']}] {line.rstrip()}")
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self) -> None:
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+
+    def stop(self, timeout: float = 15.0) -> None:
+        self.terminate()
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def wait_store_health(url: str, timeout: float) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as resp:
+                if resp.status == 200:
+                    return
+        except OSError:
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"store not healthy at {url} within {timeout}s")
+
+
+def up(manifest: dict, log=print) -> int:
+    plans = machine_plans(manifest)
+    machines = [Machine(manifest, plan, log) for plan in plans]
+    head = machines[0]
+    store_url = (
+        f"http://{manifest['head']['host']}:{manifest['store_port']}"
+    )
+    stopping = threading.Event()
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, lambda *_: stopping.set())
+
+    max_restarts = manifest.get("max_cluster_restarts")
+    restart_delay = manifest["restart_delay"]
+    restarts = 0
+
+    def launch_all() -> None:
+        head.start()
+        # the dockerize -wait gate: workers join only once the head's
+        # store answers (their stacks would otherwise crash-loop on a
+        # half-up head)
+        wait_store_health(store_url, 180)
+        log(f"[cluster] store healthy at {store_url}")
+        for machine in machines[1:]:
+            machine.start()
+        deadline = time.time() + 600
+        for machine in machines:
+            remaining = max(1.0, deadline - time.time())
+            if not machine.ready.wait(remaining):
+                raise TimeoutError(
+                    f"{machine.plan['name']} not ready within budget"
+                )
+        state = {
+            "head": manifest["head"]["host"],
+            "store_url": store_url,
+            "total_processes": total_processes(manifest),
+            "machines": [m.plan["name"] for m in machines],
+        }
+        with open("cluster_state.json", "w") as handle:
+            json.dump(state, handle)
+        log(
+            f"[cluster] up: {len(machines)} machine(s), "
+            f"{total_processes(manifest)} runtime process(es)"
+        )
+
+    def stop_all() -> None:
+        for machine in machines:
+            machine.terminate()
+        for machine in machines:
+            machine.stop()
+
+    exit_code = 0
+    try:
+        launch_all()
+        while not stopping.is_set():
+            time.sleep(0.5)
+            dead = [m for m in machines if m.poll() is not None]
+            if not dead:
+                continue
+            if max_restarts is not None and restarts >= max_restarts:
+                log(
+                    f"[cluster] {[m.plan['name'] for m in dead]} exited "
+                    f"after {restarts} cluster restarts; giving up"
+                )
+                exit_code = 1
+                break
+            restarts += 1
+            log(
+                f"[cluster] {[m.plan['name'] for m in dead]} exited — "
+                "restarting the whole cluster (a lost member poisons "
+                f"the collective runtime), #{restarts} in {restart_delay}s"
+            )
+            stop_all()
+            time.sleep(restart_delay)
+            try:
+                launch_all()
+            except Exception as error:  # noqa: BLE001
+                # a slow recovery (long WAL replay, stalled member) is a
+                # restartable condition, not the end of supervision: the
+                # loop sees the dead members next tick and retries under
+                # the same max_restarts budget
+                log(f"[cluster] relaunch failed ({error}); will retry")
+    finally:
+        log("[cluster] shutting down")
+        stop_all()
+    return exit_code
+
+
+def render(manifest: dict) -> None:
+    for plan in machine_plans(manifest):
+        print(f"# {plan['name']}")
+        if manifest["transport"] == "local":
+            env = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in plan["env"].items()
+            )
+            print(f"env {env} {sys.executable} deploy/stack.py")
+        else:
+            print(" ".join(shlex.quote(a) for a in plan_command(manifest, plan)))
+        print()
+
+
+def main() -> int:
+    if len(sys.argv) != 3 or sys.argv[1] not in ("up", "render"):
+        print(__doc__)
+        return 2
+    manifest = load_manifest(sys.argv[2])
+    if sys.argv[1] == "render":
+        render(manifest)
+        return 0
+    return up(manifest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

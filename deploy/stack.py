@@ -39,6 +39,22 @@ Environment (all optional):
                         runtime; any runtime member dying restarts the
                         whole group (see _supervise_multihost)
 - ``LO_COORD_PORT``     jax.distributed coordinator port (default 12355)
+
+Cross-MACHINE topologies run one stack.py per machine (driven by
+``deploy/cluster.py up <manifest>``, the reference's ``run.sh`` +
+``docker stack deploy`` analogue, run.sh:8-32):
+
+- ``LO_TOTAL_PROCESSES``  total jax processes across ALL machines
+                          (default: local workers + 1). When it exceeds
+                          the local member count, a runtime member dying
+                          EXITS the stack (rc=1) instead of restarting
+                          locally — members on other machines are
+                          poisoned too, so only the cluster driver can
+                          restart the runtime coherently.
+- ``LO_PROCESS_BASE``     first jax process id on this machine. > 0
+                          means a WORKER-ONLY machine: no store, no
+                          coordinator; requires ``LO_COORDINATOR`` and
+                          ``LO_STORE_URL`` pointing at the head machine.
 """
 
 from __future__ import annotations
@@ -220,8 +236,24 @@ def main() -> int:
     signal.signal(signal.SIGINT, shutdown)
 
     workers = int(os.environ.get("LO_WORKERS", "0") or 0)
+    process_base = int(os.environ.get("LO_PROCESS_BASE", "0") or 0)
+    total_processes = int(os.environ.get("LO_TOTAL_PROCESSES", "0") or 0)
     try:
-        if workers > 0:
+        if process_base > 0:
+            exit_code = _supervise_workers_only(
+                children,
+                base_env,
+                restart_delay,
+                write_ports,
+                stopping,
+                log,
+                workers,
+                process_base,
+                data_dir,
+            )
+        elif workers > 0 or total_processes > 1:
+            # total > 1 with no local workers = the head machine of a
+            # cross-machine runtime whose workers all live elsewhere
             exit_code = _supervise_multihost(
                 children,
                 store,
@@ -362,6 +394,75 @@ def _supervise(
     return exit_code
 
 
+def _supervise_workers_only(
+    children,
+    base_env,
+    restart_delay,
+    write_ports,
+    stopping,
+    log,
+    workers: int,
+    process_base: int,
+    data_dir: str,
+) -> int:
+    """A worker-only machine of a cross-machine runtime
+    (``LO_PROCESS_BASE`` > 0): supervise ``LO_WORKERS`` SPMD worker
+    processes with jax process ids ``base..base+N-1``, joined to the
+    head machine's coordinator (``LO_COORDINATOR``) and store
+    (``LO_STORE_URL``). The reference analogue is a machine running only
+    ``sparkworker`` replicas (docker-compose.yml:133-163). Any member
+    dying exits the stack (rc=1): the cross-machine collective cannot
+    heal locally, the cluster driver relaunches every machine's group.
+    """
+    workers = workers or 1
+    total = int(base_env.get("LO_TOTAL_PROCESSES", "0") or 0)
+    missing = [
+        knob
+        for knob in ("LO_COORDINATOR", "LO_STORE_URL")
+        if not base_env.get(knob)
+    ]
+    if missing or total <= 0:
+        missing += ["LO_TOTAL_PROCESSES"] if total <= 0 else []
+        log(f"[stack] worker-only mode requires {', '.join(missing)}")
+        return 2
+
+    def worker_env(process_id: int) -> dict:
+        env = dict(base_env)
+        env["LO_NUM_PROCESSES"] = str(total)
+        env["LO_PROCESS_ID"] = str(process_id)
+        env.setdefault("LO_MODELS_DIR", os.path.join(data_dir, "models"))
+        env.pop("LO_SERVICE", None)
+        return env
+
+    names = [f"worker{process_base + i}" for i in range(workers)]
+    for index, name in enumerate(names):
+        child = Child(
+            name,
+            [sys.executable, "-m", "learningorchestra_tpu.services.runner"],
+            worker_env(process_base + index),
+            log,
+        )
+        children[name] = child
+        child.start()
+    for name in names:
+        children[name].wait_ready(300)
+    write_ports()
+    log(
+        f"[stack] worker group up: processes "
+        f"{process_base}..{process_base + workers - 1} of {total}"
+    )
+    while not stopping.is_set():
+        time.sleep(0.5)
+        dead = [name for name in names if children[name].poll() is not None]
+        if dead:
+            log(
+                f"[stack] runtime member(s) {dead} died in a "
+                "cross-machine runtime; exiting for the cluster driver"
+            )
+            return 1
+    return 0
+
+
 def _supervise_multihost(
     children,
     store,
@@ -402,7 +503,13 @@ def _supervise_multihost(
     log(f"[stack] store healthy at {store_url}")
 
     coord_port = os.environ.get("LO_COORD_PORT", "12355")
-    num_processes = workers + 1
+    num_processes = int(
+        base_env.get("LO_TOTAL_PROCESSES", "0") or 0
+    ) or (workers + 1)
+    # more processes than this machine hosts = a cross-machine runtime:
+    # a local group restart cannot heal it (remote members are poisoned
+    # too), so member death exits the stack for the cluster driver
+    cross_machine = num_processes > workers + 1
 
     def runtime_env(process_id: int) -> dict:
         env = dict(base_env)
@@ -418,7 +525,9 @@ def _supervise_multihost(
         env.pop("LO_SERVICE", None)  # coordinator runs all-in-one
         return env
 
-    group_names = ["coordinator"] + [f"worker{i}" for i in range(1, num_processes)]
+    # LOCAL members only: with LO_TOTAL_PROCESSES set, processes beyond
+    # workers+1 live on other machines (their stacks run LO_PROCESS_BASE)
+    group_names = ["coordinator"] + [f"worker{i}" for i in range(1, workers + 1)]
     group_restarts = 0
 
     def launch_group() -> None:
@@ -525,6 +634,13 @@ def _supervise_multihost(
             if children[name].poll() is not None
         ]
         if dead and not stopping.is_set():
+            if cross_machine:
+                log(
+                    f"[stack] runtime member(s) {dead} died in a "
+                    "cross-machine runtime; exiting for the cluster "
+                    "driver to relaunch every machine's group"
+                )
+                return 1
             if max_restarts is not None and group_restarts >= max_restarts:
                 log(
                     f"[stack] runtime member(s) {dead} died after "

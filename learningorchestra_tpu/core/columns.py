@@ -42,6 +42,7 @@ replication), and numpy hand-off to the compute layer (core/table.py).
 from __future__ import annotations
 
 import base64
+import os
 from collections import Counter
 from typing import Any, Iterable, Optional
 
@@ -199,11 +200,15 @@ class Column:
         "intm",
         "edits",
         "_shared",
+        "spill",
     )
 
     def __init__(self, kind: str = EMPTY):
         self.kind = kind
         self.size = 0
+        # Out-of-core state: {"dir", "prefix"} once the payload lives in
+        # disk-backed mappings (spill_to); None = all-RAM buffers.
+        self.spill: Optional[dict] = None
         if kind == OBJ:
             self.data: Any = []
         elif kind == VEC:
@@ -435,20 +440,28 @@ class Column:
         clone.miss = self.miss
         clone.intm = self.intm
         clone.edits = dict(self.edits) if self.edits else None
+        # The clone READS the shared mapping but must never take the
+        # append-into-file path — only one column may own the file tail.
+        clone.spill = None
         clone._shared = True
         self._shared = True
         return clone
 
     def _own(self) -> None:
-        """Copy shared buffers before an in-place mutation."""
+        """Copy shared buffers before an in-place mutation.
+
+        ``np.array`` (not ``.copy()``) for mapped buffers: a memmap's
+        ``.copy()`` preserves the subclass, which would leave a RAM
+        column still claiming to be spilled."""
         if not self._shared:
             return
         if self.kind == OBJ:
             self.data = list(self.data)
         else:
-            self.data = self.data.copy()
+            self.data = np.array(self.data)
         if self.offsets is not None:
-            self.offsets = self.offsets.copy()
+            self.offsets = np.array(self.offsets)
+        self.spill = None  # buffers are anonymous RAM again
         for slot in ("none", "miss", "intm"):
             mask = getattr(self, slot)
             if mask is not None:
@@ -524,6 +537,8 @@ class Column:
         if merged != self.kind or (merged == NUM and other.kind != NUM):
             return self._append_promoted(other, merged)
         offset = self.size
+        if self.is_spilled() and merged not in (OBJ, EMPTY):
+            return self._append_spilled(other, merged)
         if merged == OBJ:
             if self._shared:
                 self.data = list(self.data[: self.size])
@@ -744,6 +759,131 @@ class Column:
         fresh = Column.from_strings(values, none)
         fresh.miss = self.miss[: self.size].copy() if self.miss is not None else None
         return fresh
+
+    # --- out-of-core spill ----------------------------------------------------
+    def is_spilled(self) -> bool:
+        # both conditions: snapshots/slices share the mapping (memmap
+        # instance) without owning the file (spill is None), and an
+        # _own() copy drops both
+        return self.spill is not None and isinstance(self.data, np.memmap)
+
+    def resident_nbytes(self) -> int:
+        """Anonymous-RAM bytes held by this column's buffers — memmapped
+        payloads excluded (their pages are file-backed and evictable).
+        The store's spill policy budgets against this, not nbytes()."""
+        if self.kind == OBJ:
+            return self.size * 64  # boxed estimate, never spillable
+        total = 0
+        if not isinstance(self.data, np.memmap):
+            total += self.data.nbytes
+        if self.offsets is not None and not isinstance(
+            self.offsets, np.memmap
+        ):
+            total += self.offsets.nbytes
+        for slot in ("none", "miss", "intm"):
+            mask = getattr(self, slot)
+            if mask is not None:
+                total += mask.nbytes
+        return total
+
+    def _spill_paths(self) -> tuple[str, str]:
+        base = os.path.join(self.spill["dir"], self.spill["prefix"])
+        return base + ".data", base + ".offsets"
+
+    def spill_to(self, directory: str, prefix: str) -> int:
+        """Move the live payload into files under ``directory`` and
+        remap it read-only (``np.memmap``): stored bytes leave anonymous
+        RAM and ride the page cache instead — the store's disk-ownership
+        story (the reference leans on Mongo's data volumes for this,
+        docker-compose.yml:335-340). Returns RAM bytes released; 0 when
+        not spillable (obj/empty/zero-size or already spilled).
+
+        Afterwards: bulk appends stream straight to the backing file
+        (:meth:`_append_spilled`) — ingestion never pulls the column
+        back; point mutations copy-on-write back into RAM (``_own``),
+        leaving the stale file for collection drop to reclaim."""
+        if self.kind in (OBJ, EMPTY) or self.size == 0 or self.is_spilled():
+            return 0
+        folded = self._materialized()  # str edits overlay → flat layout
+        if folded is not self:
+            self.data, self.offsets = folded.data, folded.offsets
+            self.none, self.miss = folded.none, folded.miss
+            self.edits = None
+            self._shared = False
+        os.makedirs(directory, exist_ok=True)
+        self.spill = {"dir": directory, "prefix": prefix}
+        data_path, offsets_path = self._spill_paths()
+        live = int(self.offsets[self.size]) if self.kind == STR else self.size
+        payload = np.ascontiguousarray(self.data[:live])
+        released = payload.nbytes
+        payload.tofile(data_path)
+        self.data = np.memmap(
+            data_path, dtype=payload.dtype, mode="r", shape=payload.shape
+        )
+        if self.kind == STR:
+            live_offsets = np.ascontiguousarray(self.offsets[: self.size + 1])
+            released += live_offsets.nbytes
+            live_offsets.tofile(offsets_path)
+            self.offsets = np.memmap(
+                offsets_path, dtype=np.int64, mode="r", shape=(self.size + 1,)
+            )
+        # future in-place mutations must copy out of the read-only map
+        self._shared = True
+        return released
+
+    def _append_spilled(self, other: "Column", merged: str) -> "Column":
+        """Append to a spilled column by growing its backing file and
+        remapping — bulk ingestion keeps streaming to disk instead of
+        materializing the column back into RAM. Snapshot isolation
+        holds: an existing snapshot's memmap covers only its own prefix
+        of the (append-only) file."""
+        offset = self.size
+        other = other._materialized()
+        new_size = self.size + other.size
+        if other.size == 0:
+            return self
+        data_path, offsets_path = self._spill_paths()
+        if merged == STR:
+            my_bytes = int(self.offsets[self.size])
+            their_bytes = int(other.offsets[other.size])
+            with open(data_path, "ab") as handle:
+                np.ascontiguousarray(other.data[:their_bytes]).tofile(handle)
+            self.data = np.memmap(
+                data_path,
+                dtype=np.uint8,
+                mode="r",
+                shape=(my_bytes + their_bytes,),
+            )
+            shifted = np.ascontiguousarray(
+                other.offsets[1 : other.size + 1] + my_bytes, dtype=np.int64
+            )
+            with open(offsets_path, "ab") as handle:
+                shifted.tofile(handle)
+            self.offsets = np.memmap(
+                offsets_path, dtype=np.int64, mode="r", shape=(new_size + 1,)
+            )
+        else:
+            dtype = self.data.dtype
+            payload = np.ascontiguousarray(
+                other.data[: other.size], dtype=dtype
+            )
+            with open(data_path, "ab") as handle:
+                payload.tofile(handle)
+            shape = (
+                (new_size, self.data.shape[1])
+                if self.kind == VEC
+                else (new_size,)
+            )
+            self.data = np.memmap(data_path, dtype=dtype, mode="r", shape=shape)
+        self.size = new_size
+        self._append_masks(other, offset)
+        if merged == NUM:
+            intm = self._mask("intm")
+            if other.intm is not None:
+                intm[offset:new_size] = other.intm[: other.size]
+            else:
+                intm[offset:new_size] = False
+        return self
 
     def _decode_all(self) -> list:
         nbytes = int(self.offsets[self.size])

@@ -413,6 +413,17 @@ def _is_int_id(doc_id: Any) -> bool:
     return isinstance(doc_id, int) and not isinstance(doc_id, bool)
 
 
+# Columns below this size are never worth a file + mapping.
+_SPILL_MIN_COLUMN_BYTES = 16 * 1024 * 1024
+
+
+def _path_safe(name: str) -> str:
+    """Collection/field names as filesystem-safe path components."""
+    return "".join(
+        ch if ch.isalnum() or ch in "._-" else "_" for ch in name
+    ) or "_"
+
+
 class _Collection:
     """One collection's storage: a contiguous column-major block for the
     dataset body plus a row-document overlay for everything else.
@@ -586,6 +597,40 @@ class InMemoryStore(DocumentStore):
         # generation no longer matches must ABANDON — its snapshot
         # predates the resync and publishing it would revert the log.
         self._compact_gen = 0
+        # Out-of-core: RAM budget for column payloads (LO_SPILL_BYTES,
+        # 0 disables); past it, cold blocks move to disk-backed
+        # mappings under LO_SPILL_DIR (default <data_dir>/spill, or a
+        # temp dir for pure in-memory stores). See _maybe_spill.
+        self._spill_budget = float(os.environ.get("LO_SPILL_BYTES", "8e9") or 0)
+        explicit_spill_dir = os.environ.get("LO_SPILL_DIR")
+        if explicit_spill_dir:
+            # an operator-chosen directory may be shared between stores
+            # (or hold unrelated files): take a per-process subdirectory
+            # instead of claiming — and never cleaning — the root.
+            # Stale subdirs from dead processes linger until the
+            # operator clears them (spill files are process-lifetime
+            # artifacts; the WAL is the durability story).
+            self._spill_dir = os.path.join(
+                explicit_spill_dir, f"store-{os.getpid()}"
+            )
+        else:
+            self._spill_dir = (
+                os.path.join(data_dir, "spill") if data_dir else None
+            )
+            if (
+                self._spill_budget > 0
+                and self._spill_dir
+                and os.path.isdir(self._spill_dir)
+            ):
+                # OUR data_dir's spill folder: a previous process's
+                # files there are garbage — reclaim at startup
+                import shutil
+
+                shutil.rmtree(self._spill_dir, ignore_errors=True)
+        self._spill_seq = 0
+        # collection → its unique spill folder (collision-proof even for
+        # names that sanitize identically); dropped with the collection
+        self._spill_folders: dict[str, str] = {}
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
             wal_path = os.path.join(data_dir, "wal.jsonl")
@@ -921,6 +966,70 @@ class InMemoryStore(DocumentStore):
         col = self._collections.setdefault(collection, _Collection())
         col.append_columns(columns, start_id)
         col.rev += 1
+        try:
+            self._maybe_spill()
+        except OSError as error:
+            # spilling is an optimization; an unwritable/full spill disk
+            # must not fail the insert (the rows ARE applied, and the
+            # caller still writes the WAL record — aborting here would
+            # leave memory ahead of the log)
+            import sys
+
+            print(f"store: spill failed, staying in RAM: {error}",
+                  file=sys.stderr, flush=True)
+            self._spill_budget = 0.0  # stop retrying every batch
+
+    # --- out-of-core spill ----------------------------------------------------
+    def _ensure_spill_dir(self) -> str:
+        if self._spill_dir is None:
+            import tempfile
+
+            self._spill_dir = tempfile.mkdtemp(prefix="lo_spill_")
+        return self._spill_dir
+
+    def _maybe_spill(self) -> None:
+        """Under the store lock: when anonymous-RAM column bytes exceed
+        ``LO_SPILL_BYTES``, move the largest column payloads to
+        disk-backed mappings (``Column.spill_to``) — the Mongo-owns-disk
+        property (reference docker-compose.yml:335-340): the store's
+        ceiling becomes disk, with RAM as a bounded working set. Spilled
+        columns keep streaming appends straight to their files, so bulk
+        ingestion past the budget never re-materializes them; point
+        mutations copy back to RAM and the stale file is reclaimed when
+        the collection drops."""
+        if self._spill_budget <= 0:
+            return
+        candidates = []
+        resident = 0
+        for name, col in self._collections.items():
+            for field, column in col.block_columns.items():
+                bytes_here = column.resident_nbytes()
+                resident += bytes_here
+                if (
+                    bytes_here >= _SPILL_MIN_COLUMN_BYTES
+                    and not column.is_spilled()
+                ):
+                    candidates.append((bytes_here, name, field, column))
+        if resident <= self._spill_budget:
+            return
+        candidates.sort(key=lambda entry: -entry[0])
+        for bytes_here, name, field, column in candidates:
+            self._spill_seq += 1
+            folder = self._spill_folders.setdefault(
+                name,
+                os.path.join(
+                    self._ensure_spill_dir(),
+                    f"{_path_safe(name)}.{len(self._spill_folders)}",
+                ),
+            )
+            released = column.spill_to(
+                folder, f"{_path_safe(field)}.{self._spill_seq}"
+            )
+            resident -= released
+            # hysteresis: stop well under budget so the next batch does
+            # not immediately re-trigger a scan-and-spill
+            if resident <= self._spill_budget * 0.75:
+                break
 
     def _apply_update(self, collection: str, query: dict, new_values: dict) -> None:
         col = self._collections.get(collection)
@@ -1006,6 +1115,14 @@ class InMemoryStore(DocumentStore):
         with self._lock:
             self._collections.pop(collection, None)
             self._log({"op": "drop", "c": collection})
+            folder = self._spill_folders.pop(collection, None)
+            if folder is not None:
+                # reclaim the collection's spill files; memmaps still
+                # held by snapshots keep reads valid (POSIX unlink
+                # semantics) until the last reference dies
+                import shutil
+
+                shutil.rmtree(folder, ignore_errors=True)
 
     def insert_one(self, collection: str, document: dict) -> None:
         with self._lock:

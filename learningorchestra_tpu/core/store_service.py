@@ -55,8 +55,30 @@ is the single writer. HA mirrors the reference's Mongo replica set
 ``LO_REPLICATE=1`` feeds ``GET /wal``; followers started with
 ``LO_PRIMARY_URL`` tail it (:class:`ReplicationClient`, the oplog-tailing
 secondary role), serve reads, reject writes with 503, and take over on
-``POST /promote`` — promotion instead of election: one HTTP call by the
-operator or supervisor instead of a quorum protocol.
+``POST /promote``.
+
+Failover is automatic when configured (the replica-set election the
+reference gets from its Mongo arbiter, docker-compose.yml:49-91):
+
+- ``LO_AUTO_PROMOTE_S=<seconds>`` — a follower whose primary has been
+  unreachable for that long promotes ITSELF (no operator ``POST
+  /promote`` needed). Two-node semantics, stated honestly: with exactly
+  one follower there is no quorum to consult, so a network partition
+  between the pair can open a write-accepting server on each side; the
+  term fence below heals it in favor of the newest promotion when they
+  reconnect.
+- Promotions bump a **term** (primary starts at 1; each takeover is
+  ``max(seen primary term, own) + 1``), reported by ``/health``.
+- ``LO_PEERS=<url,url>`` — fencing: at startup AND every few seconds, a
+  writable server probes its peers; seeing a writable peer with a
+  HIGHER term means it was superseded while dead/partitioned, and it
+  demotes itself to a follower of that peer (full resync replaces any
+  diverged local writes). A revived old primary therefore rejoins as a
+  follower instead of silently accepting writes (round-3 advisor item).
+- :class:`RemoteStore` accepts a comma-separated URL list
+  (``LO_STORE_URL=http://a,http://b``) and re-points itself at whichever
+  server is writable when a write fails — client writes resume after a
+  failover without reconfiguration.
 """
 
 from __future__ import annotations
@@ -93,6 +115,10 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     (docker-compose.yml:27-91)."""
     app = WebApp("store")
     role = role if role is not None else {"writable": True, "poller": None}
+    role.setdefault("term", 1 if role.get("writable", True) else 0)
+    # serializes promote/demote transitions (HTTP promote vs the
+    # auto-promote monitor vs the fencing probe)
+    role.setdefault("lock", threading.Lock())
 
     def guarded(handler):
         def wrapped(request, **kwargs):
@@ -122,6 +148,7 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
         return {
             "ok": True,
             "writable": role.get("writable", True),
+            "term": role.get("term", 0),
             "columns_wire": "bin1",
         }, 200
 
@@ -137,6 +164,7 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
             feed = store.wal_feed(epoch, offset, limit=limit)
         except (AttributeError, ValueError):
             return {"error": "replication not enabled (LO_REPLICATE=1)"}, 404
+        feed["term"] = role.get("term", 0)  # followers track it for takeover
         return feed, 200
 
     @app.route("/compact", methods=("POST",))
@@ -151,21 +179,16 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
 
     @app.route("/promote", methods=("POST",))
     def promote(request):
-        """Flip this follower writable. The response reports the last
-        WAL position applied from the old primary so the operator can
-        see the acknowledged replication lag (records the dead primary
-        accepted but never shipped are LOST — durability follows the
-        new primary from here). Fencing the OLD primary is the
-        operator's step: if it revives, restart it with LO_PRIMARY_URL
-        pointing at the new primary so it rejoins as a follower instead
-        of coming back writable (deploy/README.md)."""
-        poller = role.get("poller")
-        applied = None
-        if poller is not None:
-            poller.stop()
-            applied = {"epoch": poller.epoch, "offset": poller.offset}
-        role["writable"] = True
-        return {"promoted": True, "applied_through": applied}, 200
+        """Flip this follower writable (also invoked internally by the
+        auto-promote monitor). The response reports the last WAL
+        position applied from the old primary and whether the follower
+        had drained the feed, so the operator can see the acknowledged
+        replication lag (records the dead primary accepted but never
+        shipped are LOST — durability follows the new primary from
+        here). The term bump is what fences a revived old primary: it
+        comes back with a lower term, sees this server's higher one via
+        LO_PEERS, and rejoins as a follower."""
+        return promote_role(role), 200
 
     @app.route("/collections", methods=("GET",))
     def list_collections(request):
@@ -317,6 +340,34 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     return app
 
 
+def promote_role(role: dict) -> dict:
+    """Promote the server owning ``role`` to writable primary: stop the
+    WAL poller, bump the term past every term this follower has seen.
+    Idempotent; shared by ``POST /promote`` and the auto-promote
+    monitor."""
+    with role["lock"]:
+        poller = role.get("poller")
+        applied = None
+        caught_up = None
+        if poller is not None:
+            poller.stop()
+            applied = {"epoch": poller.epoch, "offset": poller.offset}
+            caught_up = poller.caught_up
+            role["term"] = max(role.get("term", 0), poller.primary_term) + 1
+            role["poller"] = None
+        elif not role.get("writable", True):
+            role["term"] = role.get("term", 0) + 1
+        role["writable"] = True
+        return {
+            "promoted": True,
+            "term": role["term"],
+            "applied_through": applied,
+            # False = the last poll before the primary vanished still had
+            # records in flight: acknowledged-but-unshipped writes are lost
+            "caught_up": caught_up,
+        }
+
+
 class RemoteStore(DocumentStore):
     """A :class:`DocumentStore` over the store server's wire protocol.
 
@@ -330,8 +381,20 @@ class RemoteStore(DocumentStore):
         base_url: str,
         timeout: float = 600.0,
         wire_rows: Optional[int] = None,
+        failover_timeout: Optional[float] = None,
     ):
-        self.base_url = base_url.rstrip("/")
+        # A comma-separated ``base_url`` names the replica pair; the
+        # client talks to one server at a time and re-points itself at
+        # whichever peer answers /health writable when that server dies
+        # or answers 503 (follower) — how service writes resume after an
+        # auto-promotion without any reconfiguration.
+        self.urls = [u.rstrip("/") for u in base_url.split(",") if u.strip()]
+        self.base_url = self.urls[0]
+        self.failover_timeout = (
+            failover_timeout
+            if failover_timeout is not None
+            else float(os.environ.get("LO_FAILOVER_TIMEOUT_S", "30"))
+        )
         self.timeout = timeout
         # Rows per read_columns wire chunk (LO_WIRE_ROWS): bounds every
         # JSON body the data plane ships, mirroring the write batching
@@ -370,46 +433,113 @@ class RemoteStore(DocumentStore):
             )
         response.raise_for_status()
 
-    def _post(self, path: str, body: dict) -> dict:
-        response = self._session.post(
-            f"{self.base_url}{path}",
-            data=json.dumps(body),
-            headers={"Content-Type": "application/json"},
-            timeout=self.timeout,
-        )
-        self._raise_for(response)
-        return response.json()
+    def _send(self, send, retry: bool = True):
+        """Issue ``send(base_url)``, re-pointing at the writable peer on
+        connection failure or a follower's 503.
+
+        ``retry=False`` marks non-idempotent calls (inserts whose ids
+        the SERVER assigns): replaying one after a mid-write primary
+        death could duplicate rows, so those surface the original error
+        instead. Everything else is the store's idempotent contract
+        surface (inserts at explicit ids, set_column at a start_id,
+        reads): a write that landed before the old primary died
+        re-raises as the same duplicate-id KeyError a doubled local
+        call would, so callers see identical semantics either way. The
+        probe loop rides out the auto-promote window
+        (LO_FAILOVER_TIMEOUT_S)."""
+        import time
+
+        try:
+            response = send(self.base_url)
+            if (
+                response.status_code != 503
+                or len(self.urls) == 1
+                or not retry
+            ):
+                self._raise_for(response)
+                return response
+            last_error: Optional[Exception] = None
+        except requests.ConnectionError as error:
+            if len(self.urls) == 1 or not retry:
+                raise
+            last_error = error
+        deadline = time.monotonic() + self.failover_timeout
+        while True:
+            alive = []
+            for url in self.urls:
+                health = probe_health(url)
+                if health:
+                    alive.append((not health.get("writable"), url))
+            # writable server first; else any live one (serves reads now,
+            # answers writes 503 until its auto-promotion fires)
+            for _, url in sorted(alive):
+                try:
+                    response = send(url)
+                except requests.ConnectionError as error:
+                    last_error = error
+                    continue  # just died too; try the next
+                if response.status_code != 503:
+                    self.base_url = url
+                    self._raise_for(response)
+                    return response
+            if time.monotonic() > deadline:
+                if last_error is not None:
+                    raise last_error
+                raise PermissionError(
+                    "no writable store server among "
+                    + ",".join(self.urls)
+                )
+            time.sleep(0.3)
+
+    def _post(self, path: str, body: dict, retry: bool = True) -> dict:
+        data = json.dumps(body)
+        return self._send(
+            lambda base: self._session.post(
+                f"{base}{path}",
+                data=data,
+                headers={"Content-Type": "application/json"},
+                timeout=self.timeout,
+            ),
+            retry=retry,
+        ).json()
 
     def _post_frame(self, path: str, frame: bytes) -> dict:
-        response = self._session.post(
-            f"{self.base_url}{path}",
-            data=frame,
-            headers={"Content-Type": BIN_CONTENT_TYPE},
-            timeout=self.timeout,
-        )
-        self._raise_for(response)
-        return response.json()
+        return self._send(
+            lambda base: self._session.post(
+                f"{base}{path}",
+                data=frame,
+                headers={"Content-Type": BIN_CONTENT_TYPE},
+                timeout=self.timeout,
+            )
+        ).json()
 
     def _post_for_frame(self, path: str, body: dict):
         """POST JSON, receive a binary columnar frame."""
-        response = self._session.post(
-            f"{self.base_url}{path}",
-            data=json.dumps(body),
-            headers={"Content-Type": "application/json"},
-            timeout=self.timeout,
+        data = json.dumps(body)
+        return decode_frame(
+            self._send(
+                lambda base: self._session.post(
+                    f"{base}{path}",
+                    data=data,
+                    headers={"Content-Type": "application/json"},
+                    timeout=self.timeout,
+                )
+            ).content
         )
-        self._raise_for(response)
-        return decode_frame(response.content)
 
     def _get(self, path: str) -> dict:
-        response = self._session.get(f"{self.base_url}{path}", timeout=self.timeout)
-        self._raise_for(response)
-        return response.json()
+        return self._send(
+            lambda base: self._session.get(
+                f"{base}{path}", timeout=self.timeout
+            )
+        ).json()
 
     def _delete(self, path: str) -> dict:
-        response = self._session.delete(f"{self.base_url}{path}", timeout=self.timeout)
-        self._raise_for(response)
-        return response.json()
+        return self._send(
+            lambda base: self._session.delete(
+                f"{base}{path}", timeout=self.timeout
+            )
+        ).json()
 
     # --- DocumentStore implementation -----------------------------------------
     def list_collections(self) -> list[str]:
@@ -422,10 +552,21 @@ class RemoteStore(DocumentStore):
         self._delete(f"/collections/{collection}")
 
     def insert_one(self, collection: str, document: dict) -> None:
-        self._post(f"/c/{collection}/insert_one", {"document": document})
+        # retry across failover only with an explicit _id: a replayed
+        # auto-id insert would duplicate the row instead of raising the
+        # duplicate-id KeyError that makes explicit-id retries safe
+        self._post(
+            f"/c/{collection}/insert_one",
+            {"document": document},
+            retry="_id" in document,
+        )
 
     def insert_many(self, collection: str, documents: list[dict]) -> None:
-        self._post(f"/c/{collection}/insert_many", {"documents": documents})
+        self._post(
+            f"/c/{collection}/insert_many",
+            {"documents": documents},
+            retry=all("_id" in document for document in documents),
+        )
 
     def insert_columns(
         self,
@@ -651,7 +792,9 @@ class RemoteStore(DocumentStore):
 def connect(url: Optional[str] = None) -> DocumentStore:
     """The services' store factory: a :class:`RemoteStore` when a store
     URL is configured (``LO_STORE_URL`` — the analogue of the reference's
-    ``DATABASE_URL``), else a process-local WAL-backed store."""
+    ``DATABASE_URL``; a comma-separated list names the replica pair and
+    enables client-side failover), else a process-local WAL-backed
+    store."""
     url = url if url is not None else os.environ.get("LO_STORE_URL")
     if url:
         return RemoteStore(url)
@@ -681,6 +824,13 @@ class ReplicationClient:
         self.batch = batch
         self.epoch = -1
         self.offset = 0
+        # Takeover bookkeeping: the primary's term (from the /wal feed),
+        # whether the last successful poll had drained the feed, and how
+        # long the primary has been continuously unreachable (None =
+        # healthy) — what auto-promotion and the promote response report.
+        self.primary_term = 0
+        self.caught_up = False
+        self.failing_since: Optional[float] = None
         # A resync signal only marks intent; local state is replaced
         # atomically when the replacement records are actually in hand
         # (resync_apply) — never truncated on the signal alone, so a
@@ -710,6 +860,8 @@ class ReplicationClient:
         with self._apply_lock:
             if self._stop.is_set():
                 return 0
+            self.primary_term = max(self.primary_term, feed.get("term", 0))
+            self.caught_up = len(feed["records"]) < self.batch
             if feed["resync"]:
                 self.epoch = feed["epoch"]
                 self.offset = 0
@@ -733,12 +885,17 @@ class ReplicationClient:
             return len(feed["records"])
 
     def run(self) -> None:
+        import time
+
         while not self._stop.is_set():
             try:
                 applied = self.poll_once()
                 self.last_error = None
+                self.failing_since = None
             except Exception as error:  # primary down: keep serving reads
                 self.last_error = str(error)
+                if self.failing_since is None:
+                    self.failing_since = time.monotonic()
                 applied = 0
             if applied == 0:
                 self._stop.wait(self.interval)
@@ -760,12 +917,24 @@ class ReplicationClient:
             self._thread.join(timeout=10)
 
 
+def probe_health(url: str, timeout: float = 2.0) -> Optional[dict]:
+    """``/health`` of a peer store, or None when unreachable."""
+    try:
+        response = requests.get(f"{url.rstrip('/')}/health", timeout=timeout)
+        response.raise_for_status()
+        return response.json()
+    except Exception:
+        return None
+
+
 def serve(
     host: str = "127.0.0.1",
     port: int = DEFAULT_STORE_PORT,
     data_dir: Optional[str] = None,
     replicate: bool = False,
     primary_url: Optional[str] = None,
+    peers: Optional[list[str]] = None,
+    auto_promote_s: Optional[float] = None,
 ) -> ServerThread:
     """Start a store server thread; returns it (caller stops).
 
@@ -774,18 +943,93 @@ def serve(
     that primary (read-only until promoted). The server's ``role`` dict
     and poller are attached to the returned thread as ``.store_role`` /
     ``.replication`` for operators and tests.
+
+    ``peers`` (LO_PEERS) enables term fencing: at startup a
+    would-be-writable server that finds ANY writable peer joins it as a
+    follower (the revived old primary of a completed failover; also
+    makes sequential bootstrap of a fresh pair converge on one
+    primary); while running, a writable server demotes itself only to
+    a writable peer with a strictly higher term. ``auto_promote_s``
+    (LO_AUTO_PROMOTE_S) makes a follower promote itself once its
+    primary has been unreachable for that long — the election analogue
+    (reference docker-compose.yml:49-91) minus the quorum, documented
+    in the module docstring.
     """
+    import time
+
     store = InMemoryStore(
-        data_dir=data_dir, replicate=replicate or primary_url is not None
+        data_dir=data_dir,
+        replicate=replicate or primary_url is not None or bool(peers),
     )
-    role = {"writable": primary_url is None, "poller": None}
-    if primary_url is not None:
+    writable = primary_url is None
+    if writable and peers:
+        # Startup fence: a server coming up writable must make sure no
+        # peer has taken over while it was down (>= catches the revived
+        # old primary of a same-term promote race; a genuinely fresh
+        # pair starts follower-less, so no peer answers writable).
+        for peer in peers:
+            health = probe_health(peer)
+            if health and health.get("writable"):
+                writable = False
+                primary_url = peer
+                break
+    role = {"writable": writable, "poller": None, "term": 1 if writable else 0}
+    if primary_url is not None and not writable:
         role["poller"] = ReplicationClient(store, primary_url).start()
     server = ServerThread(create_store_app(store, role), host, port).start()
     server.store = store
     server.store_role = role
     server.replication = role["poller"]
-    if replicate or primary_url is not None:
+
+    def demote_to(peer: str) -> None:
+        """Superseded while writable: rejoin as a follower of ``peer``.
+        The fresh poller's epoch mismatch forces a full resync, which
+        atomically replaces any diverged local writes."""
+        with role["lock"]:
+            if not role.get("writable"):
+                return
+            role["writable"] = False
+            role["poller"] = ReplicationClient(store, peer).start()
+            server.replication = role["poller"]
+        print(f"store: fenced — rejoining as follower of {peer}", flush=True)
+
+    if peers or auto_promote_s:
+        monitor_stop = threading.Event()
+
+        def monitor():
+            while not monitor_stop.wait(1.0):
+                poller = role.get("poller")
+                if (
+                    auto_promote_s
+                    and poller is not None
+                    and poller.failing_since is not None
+                    and time.monotonic() - poller.failing_since
+                    >= auto_promote_s
+                ):
+                    result = promote_role(role)
+                    server.replication = None
+                    print(
+                        "store: primary unreachable for "
+                        f"{auto_promote_s:g}s — self-promoted "
+                        f"(term {result['term']}, caught_up="
+                        f"{result['caught_up']})",
+                        flush=True,
+                    )
+                if peers and role.get("writable"):
+                    for peer in peers:
+                        health = probe_health(peer)
+                        if (
+                            health
+                            and health.get("writable")
+                            and health.get("term", 0) > role.get("term", 0)
+                        ):
+                            demote_to(peer)
+                            break
+
+        monitor_thread = threading.Thread(target=monitor, daemon=True)
+        monitor_thread.start()
+        server.monitor_stop = monitor_stop
+    if replicate or primary_url is not None or peers:
         # The replication feed duplicates the write history in RAM —
         # on the primary AND on every follower (a follower re-logs each
         # applied record so it is promotable with full durability).
@@ -814,7 +1058,13 @@ def main() -> None:
     data_dir = os.environ.get("LO_DATA_DIR")
     replicate = os.environ.get("LO_REPLICATE") == "1"
     primary_url = os.environ.get("LO_PRIMARY_URL")
-    server = serve(host, port, data_dir, replicate, primary_url)
+    peers_env = os.environ.get("LO_PEERS", "")
+    peers = [p.strip() for p in peers_env.split(",") if p.strip()] or None
+    auto_env = os.environ.get("LO_AUTO_PROMOTE_S")
+    auto_promote_s = float(auto_env) if auto_env else None
+    server = serve(
+        host, port, data_dir, replicate, primary_url, peers, auto_promote_s
+    )
     mode = (
         f"follower of {primary_url}"
         if primary_url

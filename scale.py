@@ -118,6 +118,17 @@ def run_scale(rows: int, classifiers: list[str]) -> dict:
             r["classificator"]: round(r["timings"]["write"], 2)
             for r in results
         },
+        # every recorded phase, summed across classifiers — the
+        # difference between build_s and this total is frame prep +
+        # preprocessor + store reads (untimed host work)
+        "phase_totals_s": {
+            phase: round(
+                sum(r["timings"].get(phase, 0.0) for r in results), 2
+            )
+            for phase in sorted(
+                {phase for r in results for phase in r["timings"]}
+            )
+        },
     }
 
 

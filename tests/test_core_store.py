@@ -579,3 +579,84 @@ def test_compact_abandoned_when_resync_supersedes(tmp_path):
     reopened = InMemoryStore(data_dir=data_dir)
     assert "fresh" in reopened.list_collections()
     assert "old" not in reopened.list_collections()
+
+
+class TestSpillPolicy:
+    """LO_SPILL_BYTES: past the RAM budget the store moves the largest
+    column payloads to disk-backed mappings and keeps appending to the
+    files — stored bytes >> RAM (the reference's Mongo-owns-disk
+    property, docker-compose.yml:335-340)."""
+
+    def _store_with_budget(self, monkeypatch, tmp_path, budget: str):
+        monkeypatch.setenv("LO_SPILL_BYTES", budget)
+        monkeypatch.setenv("LO_SPILL_DIR", str(tmp_path / "spill"))
+        from learningorchestra_tpu.core.store import (
+            _SPILL_MIN_COLUMN_BYTES,
+            InMemoryStore,
+        )
+
+        return InMemoryStore(), _SPILL_MIN_COLUMN_BYTES
+
+    def test_columns_spill_past_budget_and_stay_readable(
+        self, monkeypatch, tmp_path
+    ):
+        import numpy as np
+
+        store, min_bytes = self._store_with_budget(
+            monkeypatch, tmp_path, str(32 * 1024 * 1024)
+        )
+        rows = (min_bytes // 8) + 1024  # one column just past spill size
+        store.create_collection("big")
+        values = np.arange(rows, dtype=np.float64)
+        # six such columns: ~3x the 32MB budget
+        store.insert_columns(
+            "big", {f"c{i}": values + i for i in range(6)}
+        )
+        spilled = [
+            field
+            for field, column in store._collections["big"]
+            .block_columns.items()
+            if column.is_spilled()
+        ]
+        assert spilled, "no column spilled past the budget"
+        back = store.read_column_arrays("big", ["c0", "c5"])
+        assert back["c0"].tolist()[:3] == [0.0, 1.0, 2.0]
+        assert back["c5"].tolist()[rows - 1] == float(rows - 1 + 5)
+        # appends to a spilled column keep working (streamed to file)
+        store.insert_columns(
+            "big",
+            {f"c{i}": np.array([-1.0]) for i in range(6)},
+            start_id=rows + 1,
+        )
+        assert store.count("big") == rows + 1
+        tail = store.read_column_arrays("big", ["c0"])["c0"]
+        assert tail.tolist()[-1] == -1.0
+
+    def test_drop_reclaims_spill_files(self, monkeypatch, tmp_path):
+        import os
+
+        import numpy as np
+
+        store, min_bytes = self._store_with_budget(
+            monkeypatch, tmp_path, "1"
+        )
+        rows = (min_bytes // 8) + 8
+        store.create_collection("gone")
+        store.insert_columns(
+            "gone", {"x": np.arange(rows, dtype=np.float64)}
+        )
+        spill_root = str(tmp_path / "spill")
+        assert os.path.isdir(spill_root) and os.listdir(spill_root)
+        store.drop("gone")
+        assert not any(
+            files for _, _, files in os.walk(spill_root)
+        ), "spill files not reclaimed on drop"
+
+    def test_budget_zero_disables_spill(self, monkeypatch, tmp_path):
+        import numpy as np
+
+        store, min_bytes = self._store_with_budget(monkeypatch, tmp_path, "0")
+        rows = (min_bytes // 8) + 8
+        store.create_collection("ram")
+        store.insert_columns("ram", {"x": np.arange(rows, dtype=np.float64)})
+        assert not store._collections["ram"].block_columns["x"].is_spilled()
