@@ -786,6 +786,22 @@ class Column:
                 total += mask.nbytes
         return total
 
+    def advise_cold(self) -> None:
+        """Drop this column's RESIDENT mapped pages (madvise DONTNEED on
+        the read-only shared mapping): the data stays in the page cache,
+        so a later read refaults cheaply, but the pages stop counting
+        against the process's RSS — keeping a spilled store's footprint
+        near the LO_SPILL_BYTES budget even while scans page through
+        tens of GB."""
+        import mmap as mmap_module
+
+        for buffer in (self.data, self.offsets):
+            if isinstance(buffer, np.memmap):
+                try:
+                    buffer._mmap.madvise(mmap_module.MADV_DONTNEED)
+                except (AttributeError, OSError, ValueError):
+                    pass  # platform without madvise: purely advisory
+
     def _spill_paths(self) -> tuple[str, str]:
         base = os.path.join(self.spill["dir"], self.spill["prefix"])
         return base + ".data", base + ".offsets"
@@ -811,70 +827,113 @@ class Column:
             self.edits = None
             self._shared = False
         os.makedirs(directory, exist_ok=True)
-        self.spill = {"dir": directory, "prefix": prefix}
-        data_path, offsets_path = self._spill_paths()
+        data_path = os.path.join(directory, prefix + ".data")
+        offsets_path = os.path.join(directory, prefix + ".offsets")
         live = int(self.offsets[self.size]) if self.kind == STR else self.size
         payload = np.ascontiguousarray(self.data[:live])
         released = payload.nbytes
+        # ALL file writes before any state change: a mid-spill OSError
+        # (disk full) must leave the column untouched — only orphan
+        # partial files, reclaimed with the collection/process
         payload.tofile(data_path)
-        self.data = np.memmap(
-            data_path, dtype=payload.dtype, mode="r", shape=payload.shape
-        )
+        live_offsets = None
         if self.kind == STR:
             live_offsets = np.ascontiguousarray(self.offsets[: self.size + 1])
             released += live_offsets.nbytes
             live_offsets.tofile(offsets_path)
+        self.data = np.memmap(
+            data_path, dtype=payload.dtype, mode="r", shape=payload.shape
+        )
+        if live_offsets is not None:
             self.offsets = np.memmap(
                 offsets_path, dtype=np.int64, mode="r", shape=(self.size + 1,)
             )
+        self.spill = {"dir": directory, "prefix": prefix}
         # future in-place mutations must copy out of the read-only map
         self._shared = True
         return released
+
+    def _unspill(self) -> None:
+        """Materialize the payload back into anonymous RAM (a failed
+        file append); the stale spill files are reclaimed at drop."""
+        self.data = np.array(self.data)
+        if self.offsets is not None:
+            self.offsets = np.array(self.offsets)
+        self.spill = None
 
     def _append_spilled(self, other: "Column", merged: str) -> "Column":
         """Append to a spilled column by growing its backing file and
         remapping — bulk ingestion keeps streaming to disk instead of
         materializing the column back into RAM. Snapshot isolation
         holds: an existing snapshot's memmap covers only its own prefix
-        of the (append-only) file."""
+        of the (append-only) file. Failure-safe: a partial file write
+        (disk full) truncates back to the previous length and the
+        append retries through the in-RAM path — the backing file is
+        never left with an orphan tail that would shift later records.
+        """
         offset = self.size
         other = other._materialized()
         new_size = self.size + other.size
         if other.size == 0:
             return self
         data_path, offsets_path = self._spill_paths()
-        if merged == STR:
-            my_bytes = int(self.offsets[self.size])
-            their_bytes = int(other.offsets[other.size])
-            with open(data_path, "ab") as handle:
-                np.ascontiguousarray(other.data[:their_bytes]).tofile(handle)
-            self.data = np.memmap(
-                data_path,
-                dtype=np.uint8,
-                mode="r",
-                shape=(my_bytes + their_bytes,),
-            )
-            shifted = np.ascontiguousarray(
-                other.offsets[1 : other.size + 1] + my_bytes, dtype=np.int64
-            )
-            with open(offsets_path, "ab") as handle:
-                shifted.tofile(handle)
-            self.offsets = np.memmap(
-                offsets_path, dtype=np.int64, mode="r", shape=(new_size + 1,)
-            )
-        else:
-            dtype = self.data.dtype
-            payload = np.ascontiguousarray(
-                other.data[: other.size], dtype=dtype
-            )
-            with open(data_path, "ab") as handle:
-                payload.tofile(handle)
-            shape = (
-                (new_size, self.data.shape[1])
-                if self.kind == VEC
-                else (new_size,)
-            )
-            self.data = np.memmap(data_path, dtype=dtype, mode="r", shape=shape)
+        prev_data_bytes = os.path.getsize(data_path)
+        prev_offsets_bytes = (
+            os.path.getsize(offsets_path) if self.kind == STR else 0
+        )
+        try:
+            if merged == STR:
+                my_bytes = int(self.offsets[self.size])
+                their_bytes = int(other.offsets[other.size])
+                with open(data_path, "ab") as handle:
+                    np.ascontiguousarray(other.data[:their_bytes]).tofile(
+                        handle
+                    )
+                shifted = np.ascontiguousarray(
+                    other.offsets[1 : other.size + 1] + my_bytes,
+                    dtype=np.int64,
+                )
+                with open(offsets_path, "ab") as handle:
+                    shifted.tofile(handle)
+                self.data = np.memmap(
+                    data_path,
+                    dtype=np.uint8,
+                    mode="r",
+                    shape=(my_bytes + their_bytes,),
+                )
+                self.offsets = np.memmap(
+                    offsets_path,
+                    dtype=np.int64,
+                    mode="r",
+                    shape=(new_size + 1,),
+                )
+            else:
+                dtype = self.data.dtype
+                payload = np.ascontiguousarray(
+                    other.data[: other.size], dtype=dtype
+                )
+                with open(data_path, "ab") as handle:
+                    payload.tofile(handle)
+                shape = (
+                    (new_size, self.data.shape[1])
+                    if self.kind == VEC
+                    else (new_size,)
+                )
+                self.data = np.memmap(
+                    data_path, dtype=dtype, mode="r", shape=shape
+                )
+        except OSError:
+            for path, prev in (
+                (data_path, prev_data_bytes),
+                (offsets_path, prev_offsets_bytes),
+            ):
+                try:
+                    with open(path, "r+b") as handle:
+                        handle.truncate(prev)
+                except OSError:
+                    pass
+            self._unspill()
+            return self.append_column(other)
         self.size = new_size
         self._append_masks(other, offset)
         if merged == NUM:
@@ -975,7 +1034,14 @@ class Column:
             out.size = stop - start
             base = int(source.offsets[start])
             out.data = source.data[base : int(source.offsets[stop])]
-            out.offsets = source.offsets[start : stop + 1] - base
+            # base == 0 (full-prefix reads — the projection/cast scans):
+            # keep the VIEW; subtracting would copy the whole offsets
+            # buffer (800 MB at 100M rows, per column, per read)
+            out.offsets = (
+                source.offsets[start : stop + 1]
+                if base == 0
+                else source.offsets[start : stop + 1] - base
+            )
         elif self.kind == OBJ:
             out = Column(OBJ)
             out.size = stop - start

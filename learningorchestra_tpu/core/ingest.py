@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import csv
 import io
+import os
 import tempfile
 from contextlib import ExitStack, closing
 from datetime import datetime, timezone
@@ -30,6 +31,14 @@ INVALID_URL = "invalid_url"
 DUPLICATE_FILE = "duplicate_file"
 FINISHED = "finished"
 BATCH_SIZE = 4096
+
+# Files beyond this parse as a sequence of slabs so ingest's transient
+# working set stays bounded (the whole-file native parse holds file
+# bytes + cell index in anonymous RAM — ~2x file size — which a 12 GB
+# CSV cannot afford on an out-of-core store). 0 disables slabbing.
+_SLAB_BYTES = int(
+    float(os.environ.get("LO_INGEST_SLAB_BYTES", "536870912") or 0)
+)
 
 
 class IngestError(Exception):
@@ -163,23 +172,21 @@ def ingest_csv(
 
     with ExitStack() as stack:
         path = _local_csv_path(url, stack)
-        # Native path: NUL-joined column buffers → Arrow string columns,
-        # no Python string objects between the parser and the store.
-        parsed = read_csv_string_columns(path)
-        if parsed is None:
-            parsed = _python_raw_columns(path)
-    file_header, raw_columns = parsed
-
-    from learningorchestra_tpu.core.table import insert_columns_batched
-
-    # Duplicate header names collapse last-wins, as the reference's
-    # per-row dict build did (database.py:156-169); a CSV column named
-    # `_id` is discarded the same way the reference's row ids overwrote
-    # it (database.py:161-168) — row ids are always 1..N.
-    columns = dict(zip(file_header, raw_columns))
-    columns.pop(ROW_ID, None)
-    num_rows = len(raw_columns[0]) if raw_columns else 0
-    insert_columns_batched(store, filename, columns, batch_size=batch_size)
+        if _SLAB_BYTES and os.path.getsize(path) > _SLAB_BYTES:
+            num_rows, file_header = _ingest_slabbed(
+                store, filename, path, batch_size
+            )
+        else:
+            # Native path: NUL-joined column buffers → Arrow string
+            # columns, no Python string objects between the parser and
+            # the store.
+            parsed = read_csv_string_columns(path)
+            if parsed is None:
+                parsed = _python_raw_columns(path)
+            file_header, raw_columns = parsed
+            num_rows = _insert_parsed(
+                store, filename, file_header, raw_columns, 1, batch_size
+            )
 
     store.update_one(
         filename,
@@ -187,3 +194,82 @@ def ingest_csv(
         {FINISHED: True, "fields": file_header},
     )
     return num_rows
+
+
+def _insert_parsed(
+    store, filename, file_header, raw_columns, start_id, batch_size
+) -> int:
+    """Batched columnar hand-off of one parse result.
+
+    Duplicate header names collapse last-wins, as the reference's
+    per-row dict build did (database.py:156-169); a CSV column named
+    `_id` is discarded the same way the reference's row ids overwrote
+    it (database.py:161-168) — row ids are always 1..N."""
+    from learningorchestra_tpu.core.table import insert_columns_batched
+
+    columns = dict(zip(file_header, raw_columns))
+    columns.pop(ROW_ID, None)
+    num_rows = len(raw_columns[0]) if raw_columns else 0
+    insert_columns_batched(
+        store, filename, columns, start_id=start_id, batch_size=batch_size
+    )
+    return num_rows
+
+
+def _ingest_slabbed(
+    store, filename, path, batch_size
+) -> tuple[int, list[str]]:
+    """Parse + insert a big CSV one ~slab at a time so the transient
+    working set is slab-sized, not file-sized — with the store spilling
+    past its RAM budget, total ingest memory stays bounded at any file
+    size (the Mongo-owns-disk ingestion story). Slab boundaries land
+    only on lines with balanced quotes, so quoted embedded newlines
+    never split across parses."""
+    from learningorchestra_tpu.native.loader import read_csv_string_columns
+
+    total_rows = 0
+    file_header: list[str] = []
+    with open(path, encoding="utf-8", newline="") as source:
+        header_line = source.readline()
+        file_header = next(_csv_rows(io.StringIO(header_line)))
+        while True:
+            slab_lines: list[str] = []
+            slab_bytes = 0
+            open_quotes = False
+            for line in source:
+                slab_lines.append(line)
+                if line.count('"') % 2:
+                    open_quotes = not open_quotes
+                slab_bytes += len(line)
+                if slab_bytes >= _SLAB_BYTES and not open_quotes:
+                    break
+            if not slab_lines:
+                break
+            with tempfile.NamedTemporaryFile(
+                "w",
+                suffix=".csv",
+                delete=False,
+                encoding="utf-8",
+                newline="",
+            ) as slab:
+                slab.write(header_line)
+                slab.writelines(slab_lines)
+                slab_path = slab.name
+            del slab_lines
+            try:
+                parsed = read_csv_string_columns(slab_path)
+                if parsed is None:
+                    parsed = _python_raw_columns(slab_path)
+            finally:
+                os.unlink(slab_path)
+            slab_header, raw_columns = parsed
+            total_rows += _insert_parsed(
+                store,
+                filename,
+                slab_header,
+                raw_columns,
+                total_rows + 1,
+                batch_size,
+            )
+            del parsed, raw_columns
+    return total_rows, file_header

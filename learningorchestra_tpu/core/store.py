@@ -35,6 +35,7 @@ of what the reference services use.
 from __future__ import annotations
 
 import ast
+import itertools
 import json
 import os
 import re
@@ -416,6 +417,15 @@ def _is_int_id(doc_id: Any) -> bool:
 # Columns below this size are never worth a file + mapping.
 _SPILL_MIN_COLUMN_BYTES = 16 * 1024 * 1024
 
+# Distinguishes multiple stores in ONE process under a shared
+# LO_SPILL_DIR (e.g. a primary + follower pair in tests).
+_SPILL_DIR_SEQ = itertools.count()
+
+# Seconds between advise_cold sweeps: every sweep evicts resident mapped
+# pages a concurrent scan may just have faulted in, so it is
+# rate-limited rather than run per insert batch.
+_ADVISE_INTERVAL_S = 5.0
+
 
 def _path_safe(name: str) -> str:
     """Collection/field names as filesystem-safe path components."""
@@ -605,13 +615,16 @@ class InMemoryStore(DocumentStore):
         explicit_spill_dir = os.environ.get("LO_SPILL_DIR")
         if explicit_spill_dir:
             # an operator-chosen directory may be shared between stores
-            # (or hold unrelated files): take a per-process subdirectory
+            # (or hold unrelated files): take a per-STORE subdirectory
+            # (pid + in-process sequence — a primary and follower in one
+            # process must not overwrite each other's mapped files)
             # instead of claiming — and never cleaning — the root.
             # Stale subdirs from dead processes linger until the
             # operator clears them (spill files are process-lifetime
             # artifacts; the WAL is the durability story).
             self._spill_dir = os.path.join(
-                explicit_spill_dir, f"store-{os.getpid()}"
+                explicit_spill_dir,
+                f"store-{os.getpid()}-{next(_SPILL_DIR_SEQ)}",
             )
         else:
             self._spill_dir = (
@@ -982,9 +995,16 @@ class InMemoryStore(DocumentStore):
     # --- out-of-core spill ----------------------------------------------------
     def _ensure_spill_dir(self) -> str:
         if self._spill_dir is None:
+            import atexit
+            import shutil
             import tempfile
 
             self._spill_dir = tempfile.mkdtemp(prefix="lo_spill_")
+            # a pure in-memory store's spill files have no meaning past
+            # the process (durability is the WAL's job when configured)
+            atexit.register(
+                shutil.rmtree, self._spill_dir, ignore_errors=True
+            )
         return self._spill_dir
 
     def _maybe_spill(self) -> None:
@@ -996,20 +1016,39 @@ class InMemoryStore(DocumentStore):
         columns keep streaming appends straight to their files, so bulk
         ingestion past the budget never re-materializes them; point
         mutations copy back to RAM and the stale file is reclaimed when
-        the collection drops."""
+        the collection drops.
+
+        Runs on the writer's thread under the store lock: concurrent
+        readers wait out the spill write like any other mutation
+        (bounded by one pass over the columns being spilled; a
+        copy-then-swap outside the lock, like compaction's, is the
+        escalation path if that stall ever matters)."""
         if self._spill_budget <= 0:
             return
+        import time
+
         candidates = []
+        spilled_columns = []
         resident = 0
         for name, col in self._collections.items():
             for field, column in col.block_columns.items():
                 bytes_here = column.resident_nbytes()
                 resident += bytes_here
-                if (
-                    bytes_here >= _SPILL_MIN_COLUMN_BYTES
-                    and not column.is_spilled()
-                ):
+                if column.is_spilled():
+                    spilled_columns.append(column)
+                elif bytes_here >= _SPILL_MIN_COLUMN_BYTES:
                     candidates.append((bytes_here, name, field, column))
+        # release already-spilled columns' resident mapped pages (they
+        # stay in the page cache) so RSS tracks the budget, not the
+        # bytes the last scan happened to touch — rate-limited: each
+        # sweep evicts pages concurrent scans just faulted in
+        now = time.monotonic()
+        if spilled_columns and (
+            now - getattr(self, "_last_advise", 0.0) >= _ADVISE_INTERVAL_S
+        ):
+            self._last_advise = now
+            for column in spilled_columns:
+                column.advise_cold()
         if resident <= self._spill_budget:
             return
         candidates.sort(key=lambda entry: -entry[0])
@@ -1088,6 +1127,14 @@ class InMemoryStore(DocumentStore):
         ):
             col.ensure_block_field(field)
             col.block_columns[field] = values
+            try:
+                # bulk casts land whole replacement columns: give the
+                # spill budget a chance (and advise cold mappings) so a
+                # 100M-row fieldtypes pass doesn't accumulate every
+                # converted column in RAM
+                self._maybe_spill()
+            except OSError:
+                self._spill_budget = 0.0
             return
         self._apply_set_field(
             collection,

@@ -353,10 +353,16 @@ def promote_role(role: dict) -> dict:
             poller.stop()
             applied = {"epoch": poller.epoch, "offset": poller.offset}
             caught_up = poller.caught_up
-            role["term"] = max(role.get("term", 0), poller.primary_term) + 1
+            # floor of 1: a follower that never completed a poll (primary
+            # already dead at its start) must still promote PAST the
+            # primary's term 1, or the strictly-greater fence would never
+            # demote a partitioned-but-alive old primary
+            role["term"] = (
+                max(role.get("term", 0), poller.primary_term, 1) + 1
+            )
             role["poller"] = None
         elif not role.get("writable", True):
-            role["term"] = role.get("term", 0) + 1
+            role["term"] = max(role.get("term", 0), 1) + 1
         role["writable"] = True
         return {
             "promoted": True,
@@ -459,7 +465,11 @@ class RemoteStore(DocumentStore):
                 self._raise_for(response)
                 return response
             last_error: Optional[Exception] = None
-        except requests.ConnectionError as error:
+        # Timeout included: a partitioned/hung primary raises ReadTimeout
+        # (not a ConnectionError subclass) and must also re-point —
+        # explicit-id retries stay safe either way (duplicate-id KeyError
+        # if the write had landed)
+        except (requests.ConnectionError, requests.Timeout) as error:
             if len(self.urls) == 1 or not retry:
                 raise
             last_error = error
@@ -475,7 +485,7 @@ class RemoteStore(DocumentStore):
             for _, url in sorted(alive):
                 try:
                     response = send(url)
-                except requests.ConnectionError as error:
+                except (requests.ConnectionError, requests.Timeout) as error:
                     last_error = error
                     continue  # just died too; try the next
                 if response.status_code != 503:
@@ -1029,6 +1039,19 @@ def serve(
         monitor_thread = threading.Thread(target=monitor, daemon=True)
         monitor_thread.start()
         server.monitor_stop = monitor_stop
+        # server.stop() must halt the monitor too, or every
+        # serve()-and-stop cycle leaks a thread that keeps probing peers
+        # (and could promote/demote a stopped server's role)
+        original_stop = server.stop
+
+        def stop_with_monitor(*args, **kwargs):
+            monitor_stop.set()
+            poller = role.get("poller")
+            if poller is not None:
+                poller.stop()
+            return original_stop(*args, **kwargs)
+
+        server.stop = stop_with_monitor
     if replicate or primary_url is not None or peers:
         # The replication feed duplicates the write history in RAM —
         # on the primary AND on every follower (a follower re-logs each

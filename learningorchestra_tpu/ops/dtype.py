@@ -30,6 +30,9 @@ from learningorchestra_tpu.core.store import ROW_ID, DocumentStore
 STRING_TYPE = "string"
 NUMBER_TYPE = "number"
 
+# str→number casts convert this many rows per boxed-list transient.
+_CAST_BLOCK_ROWS = 2_000_000
+
 
 def _to_string(value):
     if value is None:
@@ -139,14 +142,26 @@ def _convert_column(column: Column, field_type: str) -> Optional[Column]:
             )
         if column.kind == "str":
             # complete None/"" mask from the Arrow offsets (zero-length
-            # cells) + the null/missing masks — skips the Python scan
+            # cells) + the null/missing masks — skips the Python scan.
+            # Converted in blocks: a 100M-row cast must never hold the
+            # whole column as a boxed Python list (the out-of-core
+            # story caps the anonymous working set at block size).
             source = column._materialized()
             n = len(source)
-            empty = np.diff(source.offsets[: n + 1]) == 0
             absent = source._absent_mask()
-            if absent is not None:
-                empty |= absent
-            return _strings_to_number(source.tolist(), empty_mask=empty)
+            out: Optional[Column] = None
+            for start in range(0, max(n, 1), _CAST_BLOCK_ROWS):
+                stop = min(start + _CAST_BLOCK_ROWS, n)
+                empty = np.diff(source.offsets[start : stop + 1]) == 0
+                if absent is not None:
+                    empty = empty | absent[start:stop]
+                part = _strings_to_number(
+                    source.tolist(start, stop), empty_mask=empty
+                )
+                out = part if out is None else out.append_column(part)
+                if stop >= n:
+                    break
+            return out
         return None  # obj/bool/empty: exact per-value loop
     if field_type == STRING_TYPE:
         if column.kind in ("f8", "i8", "num"):
@@ -192,13 +207,16 @@ def convert_field_types(
         contiguous = num_rows == 0 or all(
             ids[i] == ids[0] + i for i in range(num_rows)
         )
+    del ids_column, columns[ROW_ID]  # 100M ids: don't hold for the pass
     for field, field_type in field_types.items():
-        converted = _convert_column(columns[field], field_type)
+        source = columns.pop(field)  # release each snapshot as it casts
+        converted = _convert_column(source, field_type)
         if converted is None:
             convert = converters[field_type]
             converted = Column.from_values(
-                [convert(value) for value in columns[field].tolist()]
+                [convert(value) for value in source.tolist()]
             )
+        del source
         if contiguous:
             # one bulk column write (block-replace fast path in the store)
             store.set_column(

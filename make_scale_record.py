@@ -1,0 +1,61 @@
+"""Assemble SCALE_r05.json from this round's recorded scale runs.
+
+Each section is a verbatim scale.py output captured during round 5 on
+the bench host (one axon-tunneled v5e + 1 CPU core, 125 GB RAM), plus
+the honest context a single number cannot carry: per-run variance on
+the shared tunnel is 2-10x (see notes), so phase walls are evidence of
+behavior, not precise costs.
+
+Usage: python make_scale_record.py <product_json> <pipeline_json>
+       [northstar_json] > SCALE_r05.json
+"""
+
+import json
+import sys
+
+
+def load(path):
+    with open(path) as handle:
+        return json.load(handle)
+
+
+def main() -> None:
+    record = {
+        "round": 5,
+        "product_10m": load(sys.argv[1]),
+        "pipeline_100m_outofcore": load(sys.argv[2]),
+        "notes": {
+            "variance": (
+                "Phase wall-clocks on the axon-tunneled chip vary 2-10x "
+                "run to run (an NB fit measured at 10M rows: 4.5 s in an "
+                "isolated process vs 11-115 s inside full-suite runs; a "
+                "scalar fetch RTT measured 2.2 s). The product_10m "
+                "section is a single run, not a best-of; treat phase "
+                "splits as behavioral evidence."
+            ),
+            "outofcore": (
+                "pipeline_100m_outofcore ran with LO_SPILL_BYTES=2e9 — a "
+                "2 GB column-payload RAM budget against ~30 GB stored "
+                "(both collections): the store spilled column payloads "
+                "to disk-backed mappings and streamed ingest appends "
+                "straight to the files. A dataset that cannot fit in "
+                "RAM at all is not demonstrable on this host (125 GB "
+                "RAM, 79 GB free disk: disk is the smaller resource), "
+                "so the budget stands in: stored bytes exceed the "
+                "configured RAM budget 15x."
+            ),
+            "compile": (
+                "Padded shapes snap to a quarter-octave grid "
+                "(LO_SHAPE_BUCKETS), so any two dataset sizes within "
+                "25% share every compiled program; cache hits/misses "
+                "are recorded per run under jit_cache."
+            ),
+        },
+    }
+    if len(sys.argv) > 3:
+        record["northstar_100m"] = load(sys.argv[3])
+    print(json.dumps(record, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -218,44 +218,75 @@ def run_pipeline(rows: int) -> dict:
 
     rng = np.random.default_rng(0)
     fields = [f"f{i}" for i in range(FEATURES)]
-    X = rng.random((rows, FEATURES), dtype=np.float32) * 100
 
     start = time.perf_counter()
-    with tempfile.NamedTemporaryFile(
-        "w", suffix=".csv", delete=False
-    ) as handle:
-        handle.write(",".join(fields) + "\n")
-        for block_start in range(0, rows, 100_000):
-            block = X[block_start : block_start + 100_000]
-            lines = "\n".join(
-                ",".join(f"{v:.4f}" for v in row) for row in block
-            )
-            handle.write(lines + "\n")
-        path = handle.name
+    # LO_PIPELINE_CSV reuses (and keeps) an existing generated CSV —
+    # regenerating a 12 GB file costs ~20 min of pure setup per run
+    reuse = os.environ.get("LO_PIPELINE_CSV")
+    if reuse and os.path.exists(reuse):
+        path = reuse
+    else:
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".csv", delete=False
+        ) as handle:
+            handle.write(",".join(fields) + "\n")
+            # streamed generation: one 100k-row block live at a time, so
+            # peak RSS measures the PIPELINE's working set, not setup
+            for block_start in range(0, rows, 100_000):
+                block = rng.random(
+                    (min(100_000, rows - block_start), FEATURES),
+                    dtype=np.float32,
+                ) * 100
+                lines = "\n".join(
+                    ",".join(f"{v:.4f}" for v in row) for row in block
+                )
+                handle.write(lines + "\n")
+            path = handle.name
     csv_write_s = time.perf_counter() - start  # setup, not measured work
 
     store = InMemoryStore()
+    rss_after = {}
     try:
         store.create_collection("pipe")
         start = time.perf_counter()
         count = ingest_csv(store, "pipe", path)
         ingest_s = time.perf_counter() - start
+        rss_after["ingest"] = round(_rss_gb(), 2)
 
         keep = fields[: FEATURES // 2]
         store.create_collection("pipe_slim")
         start = time.perf_counter()
         project(store, "pipe", "pipe_slim", keep)
         projection_s = time.perf_counter() - start
+        rss_after["projection"] = round(_rss_gb(), 2)
 
         start = time.perf_counter()
         convert_field_types(
             store, "pipe_slim", {field: "number" for field in keep}
         )
         dtype_s = time.perf_counter() - start
+        rss_after["dtype"] = round(_rss_gb(), 2)
+
+        start = time.perf_counter()
+        groups = store.aggregate(
+            "pipe_slim",
+            [{"$group": {"_id": f"${keep[0]}", "count": {"$sum": 1}}}],
+        )
+        histogram_s = time.perf_counter() - start
+
+        spilled = sum(
+            1
+            for name in ("pipe", "pipe_slim")
+            for column in store._collections[name].block_columns.values()
+            if column.is_spilled()
+        )
+        stored = stored_gb(store, ["pipe", "pipe_slim"])
     finally:
-        os.unlink(path)
+        if not reuse:
+            os.unlink(path)
 
     pipeline_s = ingest_s + projection_s + dtype_s
+    peak = _rss_gb()
     return {
         "rows": count,
         "csv_bytes": rows * (FEATURES * 8),
@@ -263,8 +294,17 @@ def run_pipeline(rows: int) -> dict:
         "ingest_s": round(ingest_s, 2),
         "projection_s": round(projection_s, 2),
         "dtype_s": round(dtype_s, 2),
+        "histogram_s": round(histogram_s, 3),
+        "histogram_groups": len(groups),
         "pipeline_rows_per_sec": round(count / pipeline_s, 1),
-        "peak_rss_gb": round(_rss_gb(), 2),
+        "stored_gb": round(stored, 2),
+        "spilled_columns": spilled,
+        "spill_budget_gb": round(
+            float(os.environ.get("LO_SPILL_BYTES", "8e9") or 0) / 1e9, 2
+        ),
+        "peak_rss_gb": round(peak, 2),
+        "peak_rss_after_phase_gb": rss_after,
+        "rss_over_stored": round(peak / stored, 2) if stored else None,
     }
 
 

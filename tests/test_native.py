@@ -142,3 +142,36 @@ class TestReviewRegressions:
         columns = read_csv_columns(str(path))
         assert list(columns["x"]) == ["1_000", "2_000"]
         assert list(columns["x"]) == list(_python_read(str(path))["x"])
+
+
+class TestSlabbedIngest:
+    """Big CSVs parse as bounded slabs (core/ingest._ingest_slabbed):
+    row ids stay contiguous across slab boundaries and quoted embedded
+    newlines never split a slab mid-record."""
+
+    def test_slab_boundaries_preserve_rows_and_quotes(
+        self, tmp_path, monkeypatch
+    ):
+        import learningorchestra_tpu.core.ingest as ingest
+        from learningorchestra_tpu.core.store import InMemoryStore
+
+        path = tmp_path / "big.csv"
+        with open(path, "w", newline="") as f:
+            f.write("a,b\n")
+            for i in range(500):
+                if i % 7 == 0:
+                    # quoted cell with an embedded newline: a slab must
+                    # not end between these two physical lines
+                    f.write(f'"x{i}\ny",{i}\n')
+                else:
+                    f.write(f"v{i},{i}\n")
+        monkeypatch.setattr(ingest, "_SLAB_BYTES", 256)  # many tiny slabs
+        store = InMemoryStore()
+        store.create_collection("big")
+        count = ingest.ingest_csv(store, "big", str(path))
+        assert count == 500
+        rows = store.read_columns("big", ["a", "b"])
+        assert rows["b"] == [str(i) for i in range(500)]
+        assert rows["a"][0] == "x0\ny"
+        assert rows["a"][7] == "x7\ny"
+        assert rows["a"][1] == "v1"
