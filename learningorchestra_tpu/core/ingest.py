@@ -233,18 +233,12 @@ def _ingest_slabbed(
         header_line = source.readline()
         file_header = next(_csv_rows(io.StringIO(header_line)))
         while True:
-            slab_lines: list[str] = []
+            # stream lines STRAIGHT into the slab temp file — holding
+            # them in a list first would cost per-str object overhead
+            # several times the nominal slab size for short rows
             slab_bytes = 0
+            slab_lines = 0
             open_quotes = False
-            for line in source:
-                slab_lines.append(line)
-                if line.count('"') % 2:
-                    open_quotes = not open_quotes
-                slab_bytes += len(line)
-                if slab_bytes >= _SLAB_BYTES and not open_quotes:
-                    break
-            if not slab_lines:
-                break
             with tempfile.NamedTemporaryFile(
                 "w",
                 suffix=".csv",
@@ -253,9 +247,27 @@ def _ingest_slabbed(
                 newline="",
             ) as slab:
                 slab.write(header_line)
-                slab.writelines(slab_lines)
                 slab_path = slab.name
-            del slab_lines
+                for line in source:
+                    slab.write(line)
+                    slab_lines += 1
+                    if line.count('"') % 2:
+                        open_quotes = not open_quotes
+                    slab_bytes += len(line)
+                    if slab_bytes >= _SLAB_BYTES and (
+                        not open_quotes
+                        # hard cap: a stray quote in an unquoted field
+                        # (legal for csv.reader, e.g. inch marks) would
+                        # otherwise pin open_quotes and buffer the rest
+                        # of the file into one slab. Files quoted to
+                        # RFC-4180 never hit this; a mis-quoted file
+                        # splits where a line-based reader would.
+                        or slab_bytes >= 4 * _SLAB_BYTES
+                    ):
+                        break
+            if not slab_lines:
+                os.unlink(slab_path)
+                break
             try:
                 parsed = read_csv_string_columns(slab_path)
                 if parsed is None:

@@ -982,15 +982,22 @@ class InMemoryStore(DocumentStore):
         try:
             self._maybe_spill()
         except OSError as error:
-            # spilling is an optimization; an unwritable/full spill disk
-            # must not fail the insert (the rows ARE applied, and the
-            # caller still writes the WAL record — aborting here would
-            # leave memory ahead of the log)
-            import sys
+            self._disable_spill(error)
 
-            print(f"store: spill failed, staying in RAM: {error}",
-                  file=sys.stderr, flush=True)
-            self._spill_budget = 0.0  # stop retrying every batch
+    def _disable_spill(self, error: OSError) -> None:
+        """Spilling is an optimization; an unwritable/full spill disk
+        must not fail the mutation that triggered it (the rows ARE
+        applied, and the caller still writes the WAL record — aborting
+        would leave memory ahead of the log). Disabled loudly so an
+        operator can see why LO_SPILL_BYTES stopped being honored."""
+        import sys
+
+        print(
+            f"store: spill failed, staying in RAM from here on: {error}",
+            file=sys.stderr,
+            flush=True,
+        )
+        self._spill_budget = 0.0  # stop retrying every batch
 
     # --- out-of-core spill ----------------------------------------------------
     def _ensure_spill_dir(self) -> str:
@@ -1133,8 +1140,8 @@ class InMemoryStore(DocumentStore):
                 # 100M-row fieldtypes pass doesn't accumulate every
                 # converted column in RAM
                 self._maybe_spill()
-            except OSError:
-                self._spill_budget = 0.0
+            except OSError as error:
+                self._disable_spill(error)
             return
         self._apply_set_field(
             collection,

@@ -160,17 +160,37 @@ def _fit_segment(params, opt_state, X, y, mask, iters: int, l2):
         )
         slope = jnp.where(descent, slope, -_tree_dot(grad, grad))
 
-        # Armijo backtracking, then ONE value_and_grad at the accepted
-        # point (its gradient is reused as the next iteration's).
-        t = jnp.float32(1.0)
-        accepted = jnp.bool_(False)
-        best_t = jnp.float32(1.0 / (1 << _BACKTRACK_STEPS))
-        for _ in range(_BACKTRACK_STEPS):  # static unroll (4)
+        # Armijo backtracking as a while_loop that EXITS on acceptance —
+        # standardized features accept the unit step almost always, so
+        # the typical iteration pays ONE loss pass here (a static unroll
+        # would pay all four trial passes every iteration), then ONE
+        # value_and_grad at the accepted point (its gradient is reused
+        # as the next iteration's).
+        def ls_cond(carry):
+            _, _, accepted, k = carry
+            return (~accepted) & (k < _BACKTRACK_STEPS)
+
+        def ls_body(carry):
+            t, best_t, _, k = carry
             trial = loss(_tree_axpy(t, direction, x))
-            ok = (~accepted) & (trial <= value + _ARMIJO_C1 * t * slope)
-            best_t = jnp.where(ok, t, best_t)
-            accepted = accepted | ok
-            t = t * 0.5
+            ok = trial <= value + _ARMIJO_C1 * t * slope
+            return (
+                t * 0.5,
+                jnp.where(ok, t, best_t),
+                ok,
+                k + 1,
+            )
+
+        _, best_t, _, _ = jax.lax.while_loop(
+            ls_cond,
+            ls_body,
+            (
+                jnp.float32(1.0),
+                jnp.float32(1.0 / (1 << _BACKTRACK_STEPS)),  # step floor
+                jnp.bool_(False),
+                jnp.int32(0),
+            ),
+        )
         x_new = _tree_axpy(best_t, direction, x)
         value_new, grad_new = value_and_grad(x_new)
 
