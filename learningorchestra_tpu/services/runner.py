@@ -46,6 +46,18 @@ Environment:
   (default ``<data>/jit_cache``; empty disables). Shared safely between
   processes; turns minutes of per-process estimator compiles into
   second-scale cache loads (utils/jitcache.py).
+- ``LO_SHAPE_BUCKETS`` — ``0`` disables the quarter-octave padded-shape
+  grid (parallel/sharding.bucket_rows); default on, so nearby dataset
+  sizes reuse one compiled program per estimator.
+- ``LO_SPILL_BYTES`` / ``LO_SPILL_DIR`` — out-of-core column budget for
+  the in-process store (core/store.py): past the budget, cold column
+  payloads move to disk-backed mappings. Applies to the store SERVER
+  process in the microservice topology.
+- ``LO_INGEST_SLAB_BYTES`` — CSVs past this size parse as bounded slabs
+  (core/ingest.py), keeping ingest's transient working set slab-sized.
+- ``LO_AUTO_PROMOTE_S`` / ``LO_PEERS`` / ``LO_FAILOVER_TIMEOUT_S`` —
+  store HA: follower self-promotion, term fencing, and the client-side
+  re-point window (core/store_service.py; see deploy/README.md).
 """
 
 from __future__ import annotations
