@@ -216,12 +216,15 @@ def _nb_fit_roofline(X, y) -> dict:
     mask = jnp.ones(rows, jnp.float32)
 
     def body(i):
+        # the perturbation must feed the HEAVY op (one_hot * mask before
+        # the contraction) or XLA hoists the matmul out of the loop —
+        # i*0.0 would constant-fold and leave it loop-invariant
         theta, prior = naive_bayes._fit(
             X_dev,
             y_dev,
-            mask + i.astype(jnp.float32) * 0.0,  # break CSE via operand
+            mask + i.astype(jnp.float32) * 1e-7,
             num_classes=CLASSES,
-            smoothing=jnp.float32(1.0) + i.astype(jnp.float32) * 1e-7,
+            smoothing=jnp.float32(1.0),
         )
         return theta.sum() + prior.sum()
 

@@ -127,6 +127,7 @@ def machine_plans(manifest: dict) -> list[dict]:
         {
             "name": "head",
             "ssh": head.get("ssh"),
+            "host": head["host"],
             "env": head_env,
             "ready_markers": HEAD_READY_MARKERS,
         }
@@ -147,6 +148,7 @@ def machine_plans(manifest: dict) -> list[dict]:
             {
                 "name": f"machine{index + 1}",
                 "ssh": worker.get("ssh"),
+                "host": worker["host"],
                 "env": env,
                 "ready_markers": (WORKER_READY_MARKER,),
             }
@@ -167,7 +169,9 @@ def plan_command(manifest: dict, plan: dict) -> list[str]:
         f"cd {shlex.quote(repo)} && exec env {env_prefix} "
         f"{manifest['python']} deploy/stack.py"
     )
-    target = plan["ssh"] or plan["env"].get("LO_HOST", "")
+    # fall back to the machine's manifest host (the bind address in env
+    # is 0.0.0.0/absent for workers — not an ssh target)
+    target = plan["ssh"] or plan["host"]
     return ["ssh", "-o", "BatchMode=yes", target, remote]
 
 

@@ -239,13 +239,27 @@ def _ingest_slabbed(
             slab_bytes = 0
             slab_lines = 0
             open_quotes = False
-            with tempfile.NamedTemporaryFile(
-                "w",
-                suffix=".csv",
-                delete=False,
-                encoding="utf-8",
-                newline="",
-            ) as slab:
+            # slab next to the source file, NOT the default tempdir: on
+            # hosts where /tmp is tmpfs a 0.5-2 GB slab would be
+            # RAM-backed — the exact cost slabbing exists to avoid
+            try:
+                slab_handle = tempfile.NamedTemporaryFile(
+                    "w",
+                    suffix=".csv",
+                    delete=False,
+                    encoding="utf-8",
+                    newline="",
+                    dir=os.path.dirname(os.path.abspath(path)) or None,
+                )
+            except OSError:  # source dir unwritable: default tempdir
+                slab_handle = tempfile.NamedTemporaryFile(
+                    "w",
+                    suffix=".csv",
+                    delete=False,
+                    encoding="utf-8",
+                    newline="",
+                )
+            with slab_handle as slab:
                 slab.write(header_line)
                 slab_path = slab.name
                 for line in source:

@@ -149,6 +149,7 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
             "ok": True,
             "writable": role.get("writable", True),
             "term": role.get("term", 0),
+            "boot": role.get("boot", ""),  # equal-term fence tiebreak
             "columns_wire": "bin1",
         }, 200
 
@@ -457,11 +458,10 @@ class RemoteStore(DocumentStore):
 
         try:
             response = send(self.base_url)
-            if (
-                response.status_code != 503
-                or len(self.urls) == 1
-                or not retry
-            ):
+            # a 503 is a CLEAN rejection (nothing was applied), so even
+            # non-retryable auto-id inserts may safely re-point and
+            # retry — the retry flag only guards AMBIGUOUS failures
+            if response.status_code != 503 or len(self.urls) == 1:
                 self._raise_for(response)
                 return response
             last_error: Optional[Exception] = None
@@ -486,6 +486,11 @@ class RemoteStore(DocumentStore):
                 try:
                     response = send(url)
                 except (requests.ConnectionError, requests.Timeout) as error:
+                    if not retry:
+                        # entered via a clean 503, but THIS attempt died
+                        # ambiguously mid-request: a non-idempotent call
+                        # must not be replayed again
+                        raise
                     last_error = error
                     continue  # just died too; try the next
                 if response.status_code != 503:
@@ -983,7 +988,18 @@ def serve(
                 writable = False
                 primary_url = peer
                 break
-    role = {"writable": writable, "poller": None, "term": 1 if writable else 0}
+    import secrets
+
+    role = {
+        "writable": writable,
+        "poller": None,
+        "term": 1 if writable else 0,
+        # equal-term tiebreak for the fence: two fresh servers that
+        # both bootstrapped writable (simultaneous start, neither's
+        # probe saw the other) deterministically converge on the higher
+        # boot id instead of split-braining at term 1 == term 1
+        "boot": secrets.token_hex(8),
+    }
     if primary_url is not None and not writable:
         role["poller"] = ReplicationClient(store, primary_url).start()
     server = ServerThread(create_store_app(store, role), host, port).start()
@@ -1007,31 +1023,54 @@ def serve(
         monitor_stop = threading.Event()
 
         def monitor():
+            unwritable_since: Optional[float] = None
             while not monitor_stop.wait(1.0):
                 poller = role.get("poller")
-                if (
-                    auto_promote_s
-                    and poller is not None
-                    and poller.failing_since is not None
-                    and time.monotonic() - poller.failing_since
-                    >= auto_promote_s
-                ):
-                    result = promote_role(role)
-                    server.replication = None
-                    print(
-                        "store: primary unreachable for "
-                        f"{auto_promote_s:g}s — self-promoted "
-                        f"(term {result['term']}, caught_up="
-                        f"{result['caught_up']})",
-                        flush=True,
+                if auto_promote_s and poller is not None:
+                    # A reachable-but-UNWRITABLE primary counts as down
+                    # too: after a failover, a supervisor restart of the
+                    # promoted server (original env) can leave both
+                    # nodes followers of each other — the /wal polls
+                    # succeed, so failing_since alone never fires. Both
+                    # sides then self-promote and the term/boot fence
+                    # converges on one writer within a few ticks.
+                    if poller.failing_since is None:
+                        health = probe_health(poller.primary_url)
+                        if health is not None and not health.get("writable"):
+                            if unwritable_since is None:
+                                unwritable_since = time.monotonic()
+                        else:
+                            unwritable_since = None
+                    down_since = (
+                        poller.failing_since
+                        if poller.failing_since is not None
+                        else unwritable_since
                     )
+                    if (
+                        down_since is not None
+                        and time.monotonic() - down_since >= auto_promote_s
+                    ):
+                        result = promote_role(role)
+                        server.replication = None
+                        unwritable_since = None
+                        print(
+                            "store: primary gone/unwritable for "
+                            f"{auto_promote_s:g}s — self-promoted "
+                            f"(term {result['term']}, caught_up="
+                            f"{result['caught_up']})",
+                            flush=True,
+                        )
                 if peers and role.get("writable"):
+                    my_term = role.get("term", 0)
+                    my_boot = role.get("boot", "")
                     for peer in peers:
                         health = probe_health(peer)
-                        if (
-                            health
-                            and health.get("writable")
-                            and health.get("term", 0) > role.get("term", 0)
+                        if not health or not health.get("writable"):
+                            continue
+                        peer_term = health.get("term", 0)
+                        if peer_term > my_term or (
+                            peer_term == my_term
+                            and health.get("boot", "") > my_boot
                         ):
                             demote_to(peer)
                             break

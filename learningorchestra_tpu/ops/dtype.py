@@ -202,6 +202,7 @@ def convert_field_types(
             np.array_equal(arr, np.arange(arr[0], arr[0] + num_rows))
         )
         ids = arr.tolist() if not contiguous else ([int(arr[0])] if num_rows else [])
+        del arr  # a live view would pin the full id buffer below
     else:
         ids = ids_column.tolist()
         contiguous = num_rows == 0 or all(

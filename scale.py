@@ -220,14 +220,19 @@ def run_pipeline(rows: int) -> dict:
     fields = [f"f{i}" for i in range(FEATURES)]
 
     start = time.perf_counter()
-    # LO_PIPELINE_CSV reuses (and keeps) an existing generated CSV —
-    # regenerating a 12 GB file costs ~20 min of pure setup per run
+    # LO_PIPELINE_CSV names a persistent CSV: reused when present,
+    # GENERATED THERE when absent (and kept) — regenerating a 12 GB
+    # file costs ~20 min of pure setup per run, and generating to a
+    # throwaway temp path while the named file stays absent would leak
+    # the full file every run
     reuse = os.environ.get("LO_PIPELINE_CSV")
     if reuse and os.path.exists(reuse):
         path = reuse
     else:
-        with tempfile.NamedTemporaryFile(
-            "w", suffix=".csv", delete=False
+        with (
+            open(reuse, "w")
+            if reuse
+            else tempfile.NamedTemporaryFile("w", suffix=".csv", delete=False)
         ) as handle:
             handle.write(",".join(fields) + "\n")
             # streamed generation: one 100k-row block live at a time, so

@@ -609,3 +609,53 @@ class TestAutoFailover:
         finally:
             primary.stop()
             follower.stop()
+
+    def test_both_followers_deadlock_self_heals(self):
+        """After a failover, a supervisor restart of the promoted server
+        (original env) can leave BOTH nodes followers of each other —
+        every /wal poll succeeds, so plain unreachability timers never
+        fire. A follower must also treat a reachable-but-unwritable
+        primary as down; both sides then promote and the term/boot
+        fence converges on exactly one writer."""
+        from learningorchestra_tpu.core.store_service import serve
+
+        a = serve("127.0.0.1", 0, replicate=True)  # no takeover timer:
+        # the test pins WHICH side must win (with timers on both, either
+        # may promote first and the fence settles it — nondeterministic)
+        b_port_probe = None
+        try:
+            # B follows A; A is then demoted BY HAND to simulate the
+            # post-restart swap state (A follower of B, B follower of A)
+            b = serve(
+                "127.0.0.1",
+                0,
+                primary_url=f"http://127.0.0.1:{a.port}",
+                peers=[f"http://127.0.0.1:{a.port}"],
+                auto_promote_s=0.5,
+            )
+            try:
+                from learningorchestra_tpu.core.store_service import (
+                    ReplicationClient,
+                )
+
+                with a.store_role["lock"]:
+                    a.store_role["writable"] = False
+                    a.store_role["poller"] = ReplicationClient(
+                        a.store, f"http://127.0.0.1:{b.port}"
+                    ).start()
+                # Only B runs a takeover monitor here (A's serve() was
+                # writable so its monitor watches peers, not a poller) —
+                # B must detect its (unwritable) primary and promote.
+                deadline = time.time() + 15
+                while time.time() < deadline:
+                    if b.store_role["writable"]:
+                        break
+                    time.sleep(0.2)
+                assert b.store_role["writable"], (
+                    "follower never promoted past an unwritable primary"
+                )
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+            del b_port_probe
