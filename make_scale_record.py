@@ -48,7 +48,12 @@ def main() -> None:
                 "Padded shapes snap to a quarter-octave grid "
                 "(LO_SHAPE_BUCKETS), so any two dataset sizes within "
                 "25% share every compiled program; cache hits/misses "
-                "are recorded per run under jit_cache."
+                "are recorded per run under jit_cache. One caveat the "
+                "counters exposed: a fully-warm 10M run (55 hits, 0 "
+                "misses) still recorded ~247 s of backend-compile time "
+                "— the axon serving layer pays a per-executable load "
+                "cost on cache HITS that the client-side persistent "
+                "cache cannot remove, and it scales with congestion."
             ),
         },
     }
