@@ -20,4 +20,11 @@ cd "$(dirname "$0")/.."
 export LO_DATA_DIR="${1:-${LO_DATA_DIR:-$PWD/lo_data}}"
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
+# SPMD-safety preflight (docs/analysis.md): refuse to serve a build
+# that violates the cross-host invariants — a divergence bug found here
+# costs seconds; found in production it costs a poisoned runtime and a
+# supervisor restart. LO_ANALYSIS_WARN=1 downgrades to log-and-warn for
+# emergency hotfixes.
+python -m learningorchestra_tpu.analysis learningorchestra_tpu
+
 exec python -m learningorchestra_tpu.services.runner

@@ -1,0 +1,29 @@
+"""SPMD-safety static analyzer.
+
+The runtime's correctness invariants — coordinator-only host effects,
+byte-identical broadcast payloads, sync-free jitted code — are
+conventions the type system cannot see and the runtime only reports as
+a poisoned mesh (``SpmdTimeoutError`` → supervisor restart). This
+package machine-checks them at the AST level, before anything runs:
+
+- ``LO101`` collective/device dispatch under a process-divergent guard
+- ``LO102`` nondeterministic value flowing into a broadcast payload
+- ``LO103`` host sync hidden inside jit-compiled code
+- ``LO104`` float64 dtype in device code
+
+CLI: ``python -m learningorchestra_tpu.analysis [paths...]`` (see
+``--help``; docs/analysis.md walks through each rule and the baseline
+workflow). Library: :func:`analyze_source` / :func:`analyze_paths`.
+
+Pure stdlib — importing this package never imports jax, so the gate
+runs in constrained CI images and inside deploy/run.sh preflight.
+"""
+
+from learningorchestra_tpu.analysis.core import (
+    Finding,
+    analyze_paths,
+    analyze_source,
+)
+from learningorchestra_tpu.analysis.rules import RULES
+
+__all__ = ["Finding", "analyze_paths", "analyze_source", "RULES"]

@@ -1,0 +1,7 @@
+"""``python -m learningorchestra_tpu.analysis`` entry point."""
+
+import sys
+
+from learningorchestra_tpu.analysis.cli import main
+
+sys.exit(main())

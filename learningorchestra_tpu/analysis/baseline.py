@@ -1,0 +1,73 @@
+"""Baseline file: grandfathered findings that do not fail the build.
+
+The workflow mirrors ratchet-style lint adoption: run the analyzer with
+``--write-baseline`` once, check the file in, and from then on only NEW
+findings exit nonzero. Keys are line-number-free (``path: RULE
+message``) so unrelated edits that shift a grandfathered finding do not
+resurrect it; each occurrence consumes one baseline entry, so adding a
+second instance of a baselined pattern still fails. Paths in keys are
+relative to the BASELINE FILE's directory (posix separators), so the
+same baseline matches no matter what working directory or path spelling
+the analyzer was invoked with.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+
+from learningorchestra_tpu.analysis.core import Finding
+
+
+def baseline_root(path: str) -> str:
+    """The directory keys are anchored to: where the baseline lives."""
+    return os.path.dirname(os.path.abspath(path)) or "."
+
+_HEADER = (
+    "# learningorchestra_tpu.analysis baseline — grandfathered findings.\n"
+    "# Regenerate with: python -m learningorchestra_tpu.analysis "
+    "--write-baseline <paths>\n"
+)
+
+
+def load_baseline(path: str) -> Counter:
+    entries: Counter = Counter()
+    with open(path, encoding="utf-8") as handle:
+        for line in handle:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                entries[line] += 1
+    return entries
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    root = baseline_root(path)
+    keys = sorted(finding.baseline_key(root) for finding in findings)
+    with open(path, "w", encoding="utf-8") as handle:
+        handle.write(_HEADER)
+        for key in keys:
+            handle.write(key + "\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: Counter, root: str = "."
+) -> list[Finding]:
+    """Mark findings covered by the baseline (consuming entries), in
+    stable (path, line) order so which duplicate gets grandfathered is
+    deterministic. ``root`` must be the baseline file's directory —
+    the anchor the keys were written against."""
+    remaining = Counter(baseline)
+    marked: list[Finding] = []
+    for finding in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        key = finding.baseline_key(root)
+        if remaining[key] > 0:
+            remaining[key] -= 1
+            finding = Finding(
+                finding.path,
+                finding.line,
+                finding.rule,
+                finding.message,
+                baselined=True,
+            )
+        marked.append(finding)
+    return marked

@@ -1,0 +1,171 @@
+"""CLI: ``python -m learningorchestra_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (or every finding baselined / warn-only mode),
+1 = new findings, 2 = usage error. ``LO_ANALYSIS_WARN=1`` (or
+``--warn-only``) downgrades failures to warnings — the emergency
+escape hatch deploy/run.sh honours so a hotfix can ship while the
+finding is triaged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from learningorchestra_tpu.analysis.baseline import (
+    apply_baseline,
+    baseline_root,
+    load_baseline,
+    write_baseline,
+)
+from learningorchestra_tpu.analysis.core import analyze_paths
+from learningorchestra_tpu.analysis.rules import RULES
+
+DEFAULT_BASELINE = "analysis-baseline.txt"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m learningorchestra_tpu.analysis",
+        description=(
+            "SPMD-safety analyzer: collective deadlocks (LO101), "
+            "broadcast nondeterminism (LO102), trace-unsafe host syncs "
+            "(LO103), float64 in device code (LO104)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["."],
+        help="files or directories to analyze (default: .)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        metavar="FILE",
+        help=(
+            "baseline file of grandfathered findings (default: "
+            f"./{DEFAULT_BASELINE} when it exists)"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="RULES",
+        help="comma-separated rule ids to run (e.g. LO101,LO103)",
+    )
+    parser.add_argument(
+        "--warn-only",
+        action="store_true",
+        help="report findings but always exit 0 (also: LO_ANALYSIS_WARN=1)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id, (_check, description) in sorted(RULES.items()):
+            print(f"{rule_id}  {description}")
+        return 0
+
+    select = None
+    if args.select:
+        # strip BEFORE dropping empties: a whitespace-only token would
+        # otherwise strip to "" and prefix-match every rule
+        select = {
+            token
+            for token in (t.strip() for t in args.select.split(","))
+            if token
+        }
+        if not select:
+            print("--select given but names no rules", file=sys.stderr)
+            return 2
+        unknown = {
+            token
+            for token in select
+            if not any(rule.startswith(token) for rule in RULES)
+        }
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+
+    missing = [path for path in args.paths if not os.path.exists(path)]
+    if missing:
+        print(f"no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    # every usage error fires BEFORE the (potentially long) tree scan
+    baseline_path = args.baseline
+    if (
+        baseline_path
+        and not args.write_baseline
+        and not os.path.isfile(baseline_path)
+    ):
+        # silently analyzing without the named baseline would report
+        # every grandfathered finding as new with no hint why
+        print(f"no such baseline file: {baseline_path}", file=sys.stderr)
+        return 2
+    if baseline_path is None and os.path.isfile(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+    if args.write_baseline and select is not None:
+        # a filtered run sees a subset of findings; writing it would
+        # silently drop every other rule's grandfathered entries and
+        # break the next full preflight
+        print(
+            "--write-baseline with --select would discard other "
+            "rules' baseline entries; run without --select",
+            file=sys.stderr,
+        )
+        return 2
+
+    findings = analyze_paths(args.paths, select)
+
+    if args.write_baseline:
+        write_baseline(baseline_path or DEFAULT_BASELINE, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to "
+            f"{baseline_path or DEFAULT_BASELINE}"
+        )
+        return 0
+    if baseline_path and os.path.isfile(baseline_path):
+        findings = apply_baseline(
+            findings,
+            load_baseline(baseline_path),
+            baseline_root(baseline_path),
+        )
+
+    for finding in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(finding.render())
+    new = [finding for finding in findings if not finding.baselined]
+    if not findings:
+        print("analysis: clean")
+    elif not new:
+        print(f"analysis: {len(findings)} baselined finding(s), 0 new")
+    else:
+        print(
+            f"analysis: {len(new)} new finding(s) "
+            f"({len(findings) - len(new)} baselined)"
+        )
+    warn_env = os.environ.get("LO_ANALYSIS_WARN", "").strip().lower()
+    # "=1 downgrades": an explicit 0/false/off must keep enforcement ON
+    warn = args.warn_only or warn_env not in ("", "0", "false", "no", "off")
+    if new and not warn:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""Analyzer core: findings, suppressions, and file orchestration.
+
+A finding is one violated SPMD-safety invariant at one source location.
+The rule implementations (rules.py) yield findings; this module owns
+everything around them — walking trees of files, attaching the
+``# lo: allow[LOxxx]`` inline-suppression escape hatch, and rendering
+``file:line: LOxxx message`` output lines.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import Iterable, Iterator
+
+# `# lo: allow[LO101]`, `# lo: allow[LO101,LO103]`, `# lo: allow[*]` —
+# on the flagged line (or the line above it, for long expressions).
+_ALLOW_RE = re.compile(r"#\s*lo:\s*allow\[([A-Z0-9*,\s]+)\]")
+
+SYNTAX_RULE = "LO000"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation: ``path:line: rule message``."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+    baselined: bool = field(default=False, compare=False)
+
+    def render(self) -> str:
+        suffix = "  (baselined)" if self.baselined else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{suffix}"
+
+    def baseline_key(self, root: str | None = None) -> str:
+        """Line-number-free identity used by the baseline file, so
+        unrelated edits that shift a grandfathered finding do not make
+        it look new. ``root`` (the baseline file's directory) anchors
+        the path so the key is identical no matter what CWD or path
+        spelling the analyzer ran with."""
+        path = self.path
+        if root and path != "<string>":
+            path = os.path.relpath(os.path.abspath(path), root)
+            path = path.replace(os.sep, "/")
+        return f"{path}: {self.rule} {self.message}"
+
+
+def _allowed_rules(source_line: str) -> set[str]:
+    match = _ALLOW_RE.search(source_line)
+    if not match:
+        return set()
+    return {token.strip() for token in match.group(1).split(",")}
+
+
+def suppressed(finding: Finding, source_lines: list[str]) -> bool:
+    """True when the finding's line (or the one above) carries an
+    ``# lo: allow[...]`` comment naming the rule (or ``*``)."""
+    for lineno in (finding.line, finding.line - 1):
+        if 1 <= lineno <= len(source_lines):
+            allowed = _allowed_rules(source_lines[lineno - 1])
+            if finding.rule in allowed or "*" in allowed:
+                return True
+    return False
+
+
+def analyze_source(
+    source: str, path: str = "<string>", select: set[str] | None = None
+) -> list[Finding]:
+    """Run every rule over one module's source. ``select`` restricts to
+    a subset of rule ids (prefix match, so "LO101" and "LO1" both
+    work)."""
+    from learningorchestra_tpu.analysis import rules
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as error:
+        return [
+            Finding(
+                path,
+                error.lineno or 1,
+                SYNTAX_RULE,
+                f"syntax error: {error.msg}",
+            )
+        ]
+    source_lines = source.splitlines()
+    findings = [
+        replace(finding, path=path)
+        for finding in rules.run_rules(tree)
+    ]
+    if select is not None:
+        findings = [
+            finding
+            for finding in findings
+            if any(finding.rule.startswith(rule) for rule in select)
+            or finding.rule == SYNTAX_RULE
+        ]
+    return [
+        finding
+        for finding in findings
+        if not suppressed(finding, source_lines)
+    ]
+
+
+_SKIP_DIRS = {"__pycache__", "build", "dist", "node_modules", "venv"}
+
+
+def _skip_dir(name: str) -> bool:
+    # hidden dirs cover .git/.venv/.tox/...; the rest are vendored or
+    # generated code a directory walk must not lint (a site-packages
+    # false positive would fail the deploy preflight on third-party
+    # code). Name such a directory explicitly to analyze it anyway.
+    return (
+        name.startswith(".")
+        or name.endswith(".egg-info")
+        or name in _SKIP_DIRS
+    )
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into a sorted, deterministic module
+    list (sorted so baseline diffs and CLI output are stable). Each
+    file is yielded once even when the given paths overlap — a
+    duplicate would double-report its findings, and the second copy
+    of a baselined finding would surface as spuriously NEW."""
+    seen: set[str] = set()
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if not _skip_dir(d))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        file_path = os.path.join(root, name)
+                        if os.path.realpath(file_path) not in seen:
+                            seen.add(os.path.realpath(file_path))
+                            yield file_path
+        elif os.path.isfile(path):
+            # explicitly named files are analyzed regardless of suffix
+            # (extensionless scripts, generated files) — silently
+            # skipping them would print "clean" for a run that checked
+            # nothing
+            if os.path.realpath(path) not in seen:
+                seen.add(os.path.realpath(path))
+                yield path
+
+
+def analyze_paths(
+    paths: Iterable[str], select: set[str] | None = None
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for file_path in iter_python_files(paths):
+        try:
+            with open(file_path, encoding="utf-8") as handle:
+                source = handle.read()
+        except UnicodeDecodeError as error:
+            # a finding, not a crash — like the SyntaxError path, so
+            # the gate names the file at fault instead of dying
+            findings.append(
+                Finding(
+                    os.path.relpath(file_path),
+                    1,
+                    SYNTAX_RULE,
+                    f"not valid UTF-8: {error.reason}",
+                )
+            )
+            continue
+        except OSError as error:
+            # dangling symlink, permission-restricted file — same
+            # treatment, so warn-only mode can still downgrade it
+            findings.append(
+                Finding(
+                    os.path.relpath(file_path),
+                    1,
+                    SYNTAX_RULE,
+                    f"unreadable: {error.strerror or error}",
+                )
+            )
+            continue
+        findings.extend(
+            analyze_source(source, os.path.relpath(file_path), select)
+        )
+    return findings

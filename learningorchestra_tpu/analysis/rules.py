@@ -1,0 +1,854 @@
+"""The four SPMD-safety rule families.
+
+Every rule checks a *convention the runtime cannot enforce* — the
+invariants documented in ``parallel/spmd.py`` whose violation surfaces
+only as a poisoned runtime (``SpmdTimeoutError`` /
+``SpmdRuntimePoisonedError``) and a supervisor restart:
+
+- **LO101 collective-divergence** — code reachable only on SOME
+  processes (a ``coordinator`` / ``write_outputs`` /
+  ``jax.process_index()`` guard) must not enter device computation or
+  SPMD dispatch. A collective entered by one process and not its peers
+  deadlocks the whole mesh (parallel/spmd.py:3-7).
+- **LO102 broadcast-determinism** — values flowing into
+  ``_broadcast_json`` / dispatcher job payloads must serialize to the
+  same bytes on every process, so wall clocks, unseeded RNGs, and set
+  iteration order are banned at the source. Motivating bug:
+  ``ml/builder.py`` once derived a trace directory name from
+  ``int(time.time() * 1000)`` — a different name on every host.
+- **LO103 trace-safety** — ``@jax.jit`` bodies must not force a traced
+  value to host (``float()``/``int()``/``.item()``/``np.*``/``print``):
+  each one is a hidden device sync that devalues the persistent compile
+  cache (utils/jitcache.py) or a trace-time error.
+- **LO104 dtype hygiene** — no ``float64`` dtypes inside jitted code:
+  TPUs emulate f64 in software, and one stray widening poisons the
+  whole program's layout.
+
+The detectors are intentionally syntactic (one module at a time, no
+cross-function dataflow) — a finding must be explainable by pointing at
+the flagged line. ``# lo: allow[LOxxx]`` suppresses an intentional
+occurrence in place; the baseline file grandfathers the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from learningorchestra_tpu.analysis.core import Finding
+
+# --------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.process_index`` for a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def _last_part(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _walk_expr(root: ast.AST) -> Iterator[ast.AST]:
+    """Walk an expression subtree, pruning lambda bodies (deferred
+    code runs on the closure's schedule)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_own_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """The statement's own expressions — header tests, call arguments,
+    assignment values — WITHOUT descending into nested statement blocks
+    (callers visit those separately, with the bindings the block's own
+    statements establish) or into def/lambda bodies."""
+    stack = [
+        child
+        for child in ast.iter_child_nodes(stmt)
+        if not isinstance(child, ast.stmt)
+    ]
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------
+# jit-compiled function discovery (LO103 / LO104 scope)
+# --------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit``, ``jit``, or ``partial(jax.jit, ...)`` —
+    the decorator shapes that make a def's body traced code."""
+    if dotted(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        func = call_name(node)
+        if func in _JIT_NAMES:
+            return True
+        if func in _PARTIAL_NAMES and node.args:
+            return dotted(node.args[0]) in _JIT_NAMES
+    return False
+
+
+def jit_function_defs(tree: ast.Module) -> set[ast.AST]:
+    """Every FunctionDef whose body is traced: decorated with a jit
+    shape, wrapped via ``f = jax.jit(g)`` / ``jax.jit(g)(...)``, or
+    nested inside such a function (inner defs trace with the outer)."""
+    jitted: set[ast.AST] = set()
+    wrapped_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(dec) for dec in node.decorator_list):
+                jitted.add(node)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    wrapped_names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    jitted.add(arg)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name in wrapped_names
+        ):
+            jitted.add(node)
+    # propagate into nested defs
+    changed = True
+    while changed:
+        changed = False
+        for outer in list(jitted):
+            for node in ast.walk(outer):
+                if (
+                    isinstance(
+                        node,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    )
+                    and node not in jitted
+                ):
+                    jitted.add(node)
+                    changed = True
+    return jitted
+
+
+# --------------------------------------------------------------------
+# LO101 — collective divergence under coordinator-only guards
+# --------------------------------------------------------------------
+
+# Boolean names whose truth differs between processes of one mesh (the
+# coordinator-only conventions from parallel/spmd.py:19-21).
+DIVERGENT_NAMES = {"coordinator", "is_coordinator", "write_outputs", "render"}
+# Calls whose value differs per process; comparing one is a guard.
+DIVERGENT_CALLS = {"jax.process_index", "process_index"}
+
+# Calls that enter device computation or SPMD dispatch — the things a
+# single process must never do alone. Generic JAX collectives plus this
+# codebase's compute entry points.
+COLLECTIVE_CALLS = {
+    "_broadcast_json",
+    "broadcast_one_to_all",
+    "sync_global_devices",
+    "process_allgather",
+    "gather_model",
+    "build_model",
+    "predict_with_model",
+    "create_embedding_image",
+    "tsne_embedding",
+    "pca_embedding",
+}
+# Method-call tails that enter device programs (classifier fits and the
+# frame's device transfers), keyed on the attribute name alone.
+COLLECTIVE_METHODS = {
+    "fit",
+    "evaluate_predict",
+    "predict_both",
+    "device_matrix",
+    "device_labels",
+}
+# jax.* / jnp.* is device work unless it is one of these host-side
+# query/config prefixes.
+_JAX_HOST_SAFE_PREFIXES = (
+    "jax.process_index",
+    "jax.process_count",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.devices",
+    "jax.local_devices",
+    "jax.default_backend",
+    "jax.config",
+    "jax.monitoring",
+    "jax.distributed",
+    "jax.tree_util",
+    "jax.tree",
+)
+
+
+def _mentions_divergent_value(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in DIVERGENT_NAMES:
+            return True
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in DIVERGENT_NAMES
+        ):
+            return True
+        if isinstance(node, ast.Call) and call_name(node) in DIVERGENT_CALLS:
+            return True
+    return False
+
+
+def _collective_reason(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if name:
+        last = _last_part(name)
+        if last in COLLECTIVE_CALLS:
+            return f"{last}() enters a cross-process collective"
+        if name.startswith("jnp.") or name.startswith("jaxlib."):
+            return f"{name}() dispatches device computation"
+        if name.startswith("jax.") and not name.startswith(
+            _JAX_HOST_SAFE_PREFIXES
+        ):
+            return f"{name}() dispatches device computation"
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr in COLLECTIVE_METHODS:
+            return (
+                f".{call.func.attr}() enters a device program "
+                "(cross-process collectives on a multi-host mesh)"
+            )
+        if call.func.attr == "submit":
+            receiver = dotted(call.func.value) or ""
+            if _last_part(receiver) == "dispatcher":
+                return "dispatcher.submit() broadcasts an SPMD job"
+    return None
+
+
+def _terminates(block: list[ast.stmt]) -> bool:
+    return bool(block) and isinstance(
+        block[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class _DivergenceChecker:
+    """Walks statement blocks carrying a "this code only runs on some
+    processes" context and flags collective entries inside it."""
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+        self._reported: set[int] = set()
+
+    def check_function(self, func: ast.AST) -> None:
+        body = getattr(func, "body", [])
+        self._visit_block(body, guard=None)
+
+    @staticmethod
+    def _describe_guard(test: ast.AST) -> str:
+        # the guard's source text, NOT its line number: baseline keys
+        # are built from the message and must survive unrelated edits
+        # that shift the file around
+        text = ast.unparse(test)
+        if len(text) > 48:
+            text = text[:45] + "..."
+        return text
+
+    def _visit_block(
+        self, block: list[ast.stmt], guard: Optional[str]
+    ) -> None:
+        for index, stmt in enumerate(block):
+            if isinstance(stmt, ast.If) and _mentions_divergent_value(
+                stmt.test
+            ):
+                desc = self._describe_guard(stmt.test)
+                self._visit_block(stmt.body, guard=desc)
+                self._visit_block(stmt.orelse, guard=desc)
+                # `if not coordinator: return` makes everything AFTER
+                # the if coordinator-only — same divergence, no indent.
+                if _terminates(stmt.body) and not stmt.orelse:
+                    self._visit_block(block[index + 1 :], guard=desc)
+                    return
+                continue
+            if isinstance(stmt, ast.While) and _mentions_divergent_value(
+                stmt.test
+            ):
+                # `while coordinator:` — the body runs on a subset of
+                # processes, same divergence as an if. The else clause
+                # runs on every process (loop exit), so it keeps the
+                # OUTER guard.
+                desc = self._describe_guard(stmt.test)
+                self._visit_block(stmt.body, guard=desc)
+                self._visit_block(stmt.orelse, guard)
+                continue
+            self._visit_stmt(stmt, guard)
+
+    def _flag(self, node: ast.Call, reason: str, guard: str) -> None:
+        if id(node) in self._reported:
+            return  # one finding per call, even under nested guards
+        self._reported.add(id(node))
+        self.findings.append(
+            Finding(
+                "",
+                node.lineno,
+                "LO101",
+                f"{reason}, but this code is reachable only under the "
+                f"process-divergent guard `{guard}` — the other "
+                "processes never enter it and the mesh deadlocks "
+                "(parallel/spmd.py)",
+            )
+        )
+
+    def _flag_collectives_in(self, root: ast.AST, desc: str) -> None:
+        for sub in _walk_expr(root):
+            if isinstance(sub, ast.Call):
+                reason = _collective_reason(sub)
+                if reason:
+                    self._flag(sub, reason, desc)
+
+    def _check_ifexp(self, node: ast.AST) -> None:
+        """``gather(x) if coordinator else None`` — divergence without
+        any statement-level guard."""
+        if not (
+            isinstance(node, ast.IfExp)
+            and _mentions_divergent_value(node.test)
+        ):
+            return
+        desc = self._describe_guard(node.test)
+        for branch in (node.body, node.orelse):
+            self._flag_collectives_in(branch, desc)
+
+    def _check_boolop(self, node: ast.AST) -> None:
+        """``coordinator and gather_model(x)`` — short-circuiting makes
+        every operand after a divergent one conditionally evaluated
+        (for ``or``, on the complement subset — equally divergent)."""
+        if not isinstance(node, ast.BoolOp):
+            return
+        desc = None
+        for operand in node.values:
+            if desc is not None:
+                self._flag_collectives_in(operand, desc)
+            elif _mentions_divergent_value(operand):
+                desc = self._describe_guard(operand)
+
+    def _visit_stmt(
+        self, stmt: ast.stmt, guard: Optional[str]
+    ) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A def under a guard is not *called* under the guard —
+            # closures (worker loops, heartbeats) run on their own
+            # schedule. Reset the context; check_function covers them.
+            return
+        for node in iter_own_exprs(stmt):
+            self._check_ifexp(node)
+            self._check_boolop(node)
+        if guard is not None:
+            # own expressions only: calls inside child blocks are
+            # flagged when the recursion below reaches them — walking
+            # the whole subtree here would report a call twice (with
+            # two guard descriptions) when guards nest through a
+            # non-If compound statement
+            for node in iter_own_exprs(stmt):
+                if isinstance(node, ast.Call):
+                    reason = _collective_reason(node)
+                    if reason:
+                        self._flag(node, reason, guard)
+        # recurse into compound statements, preserving the guard
+        for child_block in (
+            getattr(stmt, "body", None),
+            getattr(stmt, "orelse", None),
+            getattr(stmt, "finalbody", None),
+        ):
+            if isinstance(child_block, list) and child_block:
+                if isinstance(child_block[0], ast.stmt):
+                    self._visit_block(child_block, guard)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._visit_block(handler.body, guard)
+        for case in getattr(stmt, "cases", []) or []:
+            self._visit_block(case.body, guard)
+
+
+def check_lo101(tree: ast.Module) -> Iterator[Finding]:
+    checker = _DivergenceChecker()
+    checker.check_function(tree)  # module level counts too
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            checker.check_function(node)
+    seen: set[tuple[int, str]] = set()
+    for finding in checker.findings:
+        key = (finding.line, finding.message)
+        if key not in seen:
+            seen.add(key)
+            yield finding
+
+
+# --------------------------------------------------------------------
+# LO102 — nondeterministic values flowing into broadcast payloads
+# --------------------------------------------------------------------
+
+NONDET_CALLS = {
+    "time.time": "wall-clock",
+    "time.time_ns": "wall-clock",
+    "time.monotonic": "per-process clock",
+    "time.monotonic_ns": "per-process clock",
+    "time.perf_counter": "per-process clock",
+    "time.perf_counter_ns": "per-process clock",
+    "os.urandom": "os entropy",
+    "os.getpid": "per-process id",
+    "uuid.uuid1": "uuid entropy",
+    "uuid.uuid4": "uuid entropy",
+    "secrets.token_hex": "os entropy",
+    "secrets.token_bytes": "os entropy",
+}
+_RANDOM_MODULE_PREFIXES = ("random.", "np.random.", "numpy.random.")
+_SEEDED_RNG_CONSTRUCTORS = {"default_rng", "RandomState", "Generator"}
+# Deterministic reductions over an unordered collection — they cleanse
+# set-iteration-order taint (but never clock/entropy taint).
+_ORDER_CLEANSERS = {"sorted", "len", "sum", "min", "max", "any", "all"}
+
+BROADCAST_SINKS = {"_broadcast_json", "broadcast_one_to_all"}
+
+
+class _TaintScanner:
+    """Per-function, single-pass taint tracking: simple assignments
+    propagate a source description from nondeterministic expressions to
+    names, and broadcast sinks check their arguments."""
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+
+    # -- taint classification ---------------------------------------
+    def _call_taint(self, call: ast.Call, env: dict) -> Optional[str]:
+        name = call_name(call)
+        if name:
+            if name in NONDET_CALLS:
+                return f"{name}() ({NONDET_CALLS[name]})"
+            if name.startswith(_RANDOM_MODULE_PREFIXES):
+                tail = _last_part(name)
+                if tail in _SEEDED_RNG_CONSTRUCTORS and call.args:
+                    return None  # explicitly seeded constructor
+                if tail == "seed":
+                    return None
+                return f"{name}() (unseeded RNG)"
+            if name in {"set", "frozenset"}:
+                return "set() (iteration order is per-process)"
+        sources = list(call.args) + [kw.value for kw in call.keywords]
+        if isinstance(call.func, ast.Attribute):
+            # method call: the receiver's taint rides through — both
+            # `default_rng().random()` and the assigned spelling
+            # `rng = default_rng(); rng.random()`
+            sources.append(call.func.value)
+        arg_taints = [
+            taint
+            for arg in sources
+            for taint in [self.taint_of(arg, env)]
+            if taint
+        ]
+        if not arg_taints:
+            return None
+        if (
+            name
+            and _last_part(name) in _ORDER_CLEANSERS
+            and all("iteration order" in taint for taint in arg_taints)
+        ):
+            return None  # sorted(set(...)) is deterministic
+        return arg_taints[0]
+
+    def taint_of(self, node: ast.AST, env: dict) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, env)
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return "set literal (iteration order is per-process)"
+        if isinstance(node, ast.JoinedStr):
+            for value in node.values:
+                if isinstance(value, ast.FormattedValue):
+                    taint = self.taint_of(value.value, env)
+                    if taint:
+                        return taint
+            return None
+        if isinstance(node, (ast.BinOp,)):
+            return self.taint_of(node.left, env) or self.taint_of(
+                node.right, env
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self.taint_of(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            for value in node.values:
+                taint = self.taint_of(value, env)
+                if taint:
+                    return taint
+            return None
+        if isinstance(node, ast.IfExp):
+            return self.taint_of(node.body, env) or self.taint_of(
+                node.orelse, env
+            )
+        if isinstance(node, ast.Dict):
+            for value in list(node.keys) + list(node.values):
+                if value is not None:
+                    taint = self.taint_of(value, env)
+                    if taint:
+                        return taint
+            return None
+        if isinstance(node, (ast.List, ast.Tuple)):
+            for element in node.elts:
+                taint = self.taint_of(element, env)
+                if taint:
+                    return taint
+            return None
+        if isinstance(node, ast.Subscript):
+            return self.taint_of(node.value, env)
+        if isinstance(node, ast.Attribute):
+            return self.taint_of(node.value, env)
+        if isinstance(node, ast.Starred):
+            return self.taint_of(node.value, env)
+        return None
+
+    # -- sinks -------------------------------------------------------
+    def _check_sink(self, call: ast.Call, env: dict) -> None:
+        name = call_name(call)
+        sink = None
+        if name and _last_part(name) in BROADCAST_SINKS:
+            sink = f"{_last_part(name)}()"
+        elif (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "submit"
+            and _last_part(dotted(call.func.value) or "")
+            == "dispatcher"
+        ):
+            sink = "dispatcher.submit() payload"
+        if not sink:
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            taint = self.taint_of(arg, env)
+            if taint:
+                self.findings.append(
+                    Finding(
+                        "",
+                        call.lineno,
+                        "LO102",
+                        f"value from {taint} flows into {sink} — every "
+                        "process must serialize an identical payload, "
+                        "or the broadcast desynchronizes the job stream "
+                        "(parallel/spmd.py)",
+                    )
+                )
+                return
+
+    # -- statement walk ----------------------------------------------
+    def scan_function(self, func: ast.AST) -> None:
+        env: dict[str, str] = {}
+        self._scan_block(getattr(func, "body", []), env)
+
+    def _bind_target(self, target: ast.AST, taint, env: dict) -> None:
+        """Assign ``taint`` to every name the target binds — through
+        tuple/list unpacking and ``*rest`` — clearing stale taint on
+        untainted rebinds."""
+        if isinstance(target, ast.Name):
+            if taint:
+                env[target.id] = taint
+            else:
+                env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for element in target.elts:
+                self._bind_target(element, taint, env)
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, taint, env)
+        elif isinstance(target, ast.Subscript):
+            # payload["key"] = tainted → payload is tainted
+            base = dotted(target.value)
+            if taint and base:
+                env[base] = taint
+
+    def _bind_assign(self, target: ast.AST, value: ast.AST, env) -> None:
+        # `a, b = time.time(), 1` — pair targets with values so only
+        # the wall-clock element taints its name
+        if (
+            isinstance(target, (ast.Tuple, ast.List))
+            and isinstance(value, (ast.Tuple, ast.List))
+            and len(target.elts) == len(value.elts)
+            and not any(
+                isinstance(n, ast.Starred)
+                for n in list(target.elts) + list(value.elts)
+            )
+        ):
+            for element, element_value in zip(target.elts, value.elts):
+                self._bind_assign(element, element_value, env)
+            return
+        self._bind_target(target, self.taint_of(value, env), env)
+
+    def _scan_block(self, block: list[ast.stmt], env: dict) -> None:
+        for stmt in block:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # fresh scope; scanned separately
+            if isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    self._bind_assign(target, stmt.value, env)
+            elif isinstance(stmt, ast.AugAssign):
+                taint = self.taint_of(stmt.value, env)
+                if taint and isinstance(stmt.target, ast.Name):
+                    env[stmt.target.id] = taint
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                taint = self.taint_of(stmt.value, env)
+                if isinstance(stmt.target, ast.Name):
+                    if taint:
+                        env[stmt.target.id] = taint
+                    else:
+                        env.pop(stmt.target.id, None)
+            if isinstance(stmt, ast.For):
+                # `for item in set(...)` / `for k, v in d.items()` —
+                # every loop-bound name carries the iteration-order
+                # taint
+                iter_taint = self.taint_of(stmt.iter, env)
+                if iter_taint:
+                    self._bind_target(stmt.target, iter_taint, env)
+            # sinks in THIS statement's own expressions only: sinks
+            # inside child blocks are checked when the recursion below
+            # reaches them, with the env their block's rebinds produce
+            # — checking them here with the pre-block env would report
+            # taint the block has already cleared
+            for node in iter_own_exprs(stmt):
+                if isinstance(node, ast.Call):
+                    self._check_sink(node, env)
+            child_blocks = [
+                child_block
+                for child_block in (
+                    getattr(stmt, "body", None),
+                    getattr(stmt, "orelse", None),
+                    getattr(stmt, "finalbody", None),
+                )
+                if isinstance(child_block, list)
+                and child_block
+                and isinstance(child_block[0], ast.stmt)
+            ]
+            child_blocks += [
+                handler.body
+                for handler in getattr(stmt, "handlers", []) or []
+            ]
+            child_blocks += [
+                case.body for case in getattr(stmt, "cases", []) or []
+            ]
+            if child_blocks:
+                # each branch scans a COPY of env; the join keeps a
+                # name tainted when ANY path taints it — sharing one
+                # mutable env would let `else: x = 1` erase the if
+                # branch's wall-clock taint before the sink after the
+                # join sees it
+                branch_envs = [
+                    self._scan_branch(child_block, env)
+                    for child_block in child_blocks
+                ]
+                # unless an if has an else, falling past the statement
+                # unchanged is itself a possible path
+                if not (isinstance(stmt, ast.If) and stmt.orelse):
+                    branch_envs.append(dict(env))
+                env.clear()
+                for branch_env in branch_envs:
+                    for name, taint in branch_env.items():
+                        env.setdefault(name, taint)
+
+    def _scan_branch(self, block: list[ast.stmt], env: dict) -> dict:
+        branch_env = dict(env)
+        self._scan_block(block, branch_env)
+        return branch_env
+
+
+def check_lo102(tree: ast.Module) -> Iterator[Finding]:
+    scanner = _TaintScanner()
+    scanner.scan_function(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scanner.scan_function(node)
+    seen: set[tuple[int, str]] = set()
+    for finding in scanner.findings:
+        key = (finding.line, finding.message)
+        if key not in seen:
+            seen.add(key)
+            yield finding
+
+
+# --------------------------------------------------------------------
+# LO103 — host syncs inside jitted code
+# --------------------------------------------------------------------
+
+_HOST_FORCE_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_FORCE_METHODS = {"item", "tolist", "numpy", "__array__"}
+# numpy helpers that are shape/dtype bookkeeping, not array math — fine
+# at trace time because they never touch a tracer's *values*.
+_NP_TRACE_SAFE = {
+    "np.dtype",
+    "np.shape",
+    "np.ndim",
+    "np.result_type",
+    "np.promote_types",
+    "np.issubdtype",
+    "np.iinfo",
+    "np.finfo",
+}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Expressions that are Python values (not tracers) inside a jit
+    body: literals, len(), and shape/dtype metadata chains."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Call) and call_name(node) == "len":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in {
+        "ndim",
+        "size",
+        "dtype",
+    }:
+        return True
+    if isinstance(node, ast.Subscript):
+        return (
+            isinstance(node.value, ast.Attribute)
+            and node.value.attr == "shape"
+        )
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+def _host_sync_reason(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if name in _HOST_FORCE_BUILTINS:
+        if call.args and all(_is_static_expr(arg) for arg in call.args):
+            return None
+        return (
+            f"{name}() on a traced value forces a device sync (or a "
+            "ConcretizationTypeError) at every call"
+        )
+    if name == "print":
+        return (
+            "print() inside jitted code runs at trace time only (or "
+            "forces a sync) — use jax.debug.print"
+        )
+    if name and (name.startswith("np.") or name.startswith("numpy.")):
+        if name in _NP_TRACE_SAFE:
+            return None
+        return (
+            f"{name}() materializes traced values on host — use the "
+            "jnp equivalent"
+        )
+    if (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _HOST_FORCE_METHODS
+    ):
+        return (
+            f".{call.func.attr}() pulls the value to host — a hidden "
+            "device sync inside the compiled program"
+        )
+    return None
+
+
+def check_lo103(tree: ast.Module) -> Iterator[Finding]:
+    jitted = jit_function_defs(tree)
+    seen: set[int] = set()
+    for func in jitted:
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call) and node.lineno not in seen:
+                reason = _host_sync_reason(node)
+                if reason:
+                    seen.add(node.lineno)
+                    yield Finding(
+                        "",
+                        node.lineno,
+                        "LO103",
+                        f"{reason} — inside a jit-compiled function, "
+                        "this devalues the persistent compile cache "
+                        "(utils/jitcache.py)",
+                    )
+
+
+# --------------------------------------------------------------------
+# LO104 — float64 dtypes in device code
+# --------------------------------------------------------------------
+
+_F64_ATTRS = {"np.float64", "numpy.float64", "jnp.float64", "np.double"}
+
+
+def _is_float64_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "float64":
+        return True
+    return dotted(node) in _F64_ATTRS
+
+
+def check_lo104(tree: ast.Module) -> Iterator[Finding]:
+    jitted = jit_function_defs(tree)
+    seen: set[int] = set()
+
+    def flag(node: ast.AST, context: str) -> Iterator[Finding]:
+        if node.lineno in seen:
+            return
+        seen.add(node.lineno)
+        yield Finding(
+            "",
+            node.lineno,
+            "LO104",
+            f"float64 dtype in {context} — TPUs emulate f64 in "
+            "software and one widening poisons the whole program; use "
+            "float32 (or rely on default dtypes)",
+        )
+
+    for func in jitted:
+        for node in ast.walk(func):
+            if _is_float64_dtype(node):
+                yield from flag(node, "a jit-compiled function")
+    # jnp calls anywhere with an explicit float64 dtype are device code
+    # even outside a jit body (op-by-op dispatch)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            if not name.startswith("jnp."):
+                continue
+            for keyword in node.keywords:
+                if keyword.arg == "dtype" and _is_float64_dtype(
+                    keyword.value
+                ):
+                    yield from flag(node, f"{name}()")
+
+
+# --------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------
+
+RULES = {
+    "LO101": (
+        check_lo101,
+        "collective or device dispatch under a process-divergent guard",
+    ),
+    "LO102": (
+        check_lo102,
+        "nondeterministic value flowing into a broadcast payload",
+    ),
+    "LO103": (check_lo103, "host sync inside jit-compiled code"),
+    "LO104": (check_lo104, "float64 dtype in device code"),
+}
+
+
+def run_rules(tree: ast.Module) -> Iterator[Finding]:
+    for rule_id, (check, _description) in RULES.items():
+        yield from check(tree)

@@ -16,9 +16,9 @@ are written back in batched columnar writes, not 1 RPC per row.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor, wait
 from typing import Optional
 
@@ -38,6 +38,30 @@ LABEL_COL = "label"
 
 # Guards the process-global JAX profiler (see build_model's trace note).
 _TRACE_LOCK = threading.Lock()
+
+# Capture directories are named from the JOB (dataset name + build
+# sequence number), never the wall clock: this line once used
+# ``int(time.time() * 1000)``, which on a multi-host mesh computes a
+# DIFFERENT name on every process — the bug class that motivated the
+# analyzer's LO102 broadcast-determinism rule (analysis/rules.py; the
+# rule itself checks broadcast/dispatch payloads, not artifact paths).
+# Tracing is also coordinator-only now, but the deterministic name
+# keeps captures correlatable with their request across hosts and runs.
+_TRACE_SEQ = itertools.count()
+
+
+def _next_trace_dir(trace_root: str, test_filename: str) -> str:
+    for seq in _TRACE_SEQ:
+        path = os.path.join(trace_root, f"build_{test_filename}_{seq:03d}")
+        try:
+            # makedirs IS the reservation: an exists() probe would let
+            # two server processes sharing LO_TRACE_DIR claim the same
+            # name before either profiler writes it
+            os.makedirs(path)
+        except FileExistsError:  # taken by an earlier run or a peer
+            continue
+        return path
+    raise AssertionError("unreachable: itertools.count is infinite")
 
 
 def load_dataframe(store: DocumentStore, filename: str) -> DataFrame:
@@ -304,17 +328,24 @@ def build_model(
                 ) from None
     # LO_TRACE_DIR: device-level tracing of the whole fan-out (fits,
     # predictions, writes) into a TensorBoard/Perfetto profile dir —
-    # one timestamped capture per build, named after the test dataset.
-    # The JAX profiler is process-global and non-reentrant, so a build
-    # that overlaps an active capture runs untraced rather than failing:
+    # one capture per build, named after the test dataset. The JAX
+    # profiler is process-global and non-reentrant, so a build that
+    # overlaps an active capture runs untraced rather than failing:
     # tracing is observability, never a reason to 500 a request.
+    # Coordinator-only (write_outputs), like every other host-side
+    # artifact (parallel/spmd.py:19-21): worker processes run the same
+    # compute but must not write to the trace volume.
     trace_root = os.environ.get("LO_TRACE_DIR")
     trace_dir = None
-    tracing = trace_root and _TRACE_LOCK.acquire(blocking=False)
+    tracing = (
+        trace_root and write_outputs and _TRACE_LOCK.acquire(blocking=False)
+    )
     if tracing:
-        trace_dir = os.path.join(
-            trace_root, f"build_{test_filename}_{int(time.time() * 1000)}"
-        )
+        try:
+            trace_dir = _next_trace_dir(trace_root, test_filename)
+        except OSError:  # unwritable/full trace volume: run untraced
+            _TRACE_LOCK.release()
+            tracing = False
     try:
         return _build_model_traced(
             store,

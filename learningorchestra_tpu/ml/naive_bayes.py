@@ -29,7 +29,6 @@ from learningorchestra_tpu.ml.base import (
     prepare_xy,
     resolve_mesh,
 )
-from learningorchestra_tpu.parallel.multihost import fetch
 
 
 @partial(jax.jit, static_argnames=("num_classes",))

@@ -47,7 +47,6 @@ from learningorchestra_tpu.ml.base import (
 )
 from learningorchestra_tpu.ml.binning import MAX_BINS, apply_bins, make_thresholds
 from learningorchestra_tpu.parallel.mesh import MODEL_AXIS, model_size
-from learningorchestra_tpu.parallel.multihost import fetch
 
 MAX_DEPTH = 5          # MLlib default maxDepth
 NUM_TREES = 20         # MLlib default numTrees (RF)

@@ -1,0 +1,850 @@
+"""SPMD-safety analyzer: per-rule fixtures, CLI contract, repo gate.
+
+Every rule family (LO101–LO104) gets at least one positive (bad code
+the rule must flag) and one negative (the nearby good idiom it must NOT
+flag) fixture. The gate at the bottom runs the analyzer over the real
+source trees and asserts zero non-baselined findings — the invariant
+the tentpole exists to enforce on every PR.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from learningorchestra_tpu.analysis import analyze_source
+from learningorchestra_tpu.analysis.cli import main as cli_main
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def findings_for(source: str, select=None):
+    return analyze_source(textwrap.dedent(source), "probe.py", select)
+
+
+def rules_of(source: str) -> set:
+    return {finding.rule for finding in findings_for(source)}
+
+
+# --------------------------------------------------------------------
+# LO101 — collective divergence
+# --------------------------------------------------------------------
+
+
+class TestLO101CollectiveDivergence:
+    def test_jnp_dispatch_under_coordinator_guard(self):
+        src = """
+            import jax.numpy as jnp
+
+            def handler(payload, coordinator):
+                if coordinator:
+                    return jnp.sum(payload["x"])
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_collective_under_write_outputs_guard(self):
+        src = """
+            def handler(model, write_outputs):
+                if write_outputs:
+                    gathered = gather_model(model)
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_early_return_guard_poisons_rest_of_function(self):
+        # `if process_index() != 0: return` makes everything after it
+        # coordinator-only — the deadlock shape without any indentation
+        src = """
+            import jax
+
+            def handler(model, payload):
+                if jax.process_index() != 0:
+                    return
+                model.fit(payload)
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_else_branch_is_equally_divergent(self):
+        src = """
+            def handler(dispatcher, payload, coordinator):
+                if coordinator:
+                    pass
+                else:
+                    dispatcher.submit("op", payload)
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_host_writes_under_guard_are_fine(self):
+        src = """
+            def handler(store, metadata, write_outputs):
+                if write_outputs:
+                    store.insert_one("out", metadata)
+        """
+        assert rules_of(src) == set()
+
+    def test_collective_outside_guard_is_fine(self):
+        src = """
+            import jax.numpy as jnp
+
+            def handler(payload, coordinator):
+                total = jnp.sum(payload["x"])
+                if coordinator:
+                    print(total)
+        """
+        assert rules_of(src) == set()
+
+    def test_process_count_is_not_a_divergence_guard(self):
+        # process_count is identical on every process — `if
+        # jax.process_count() == 1` selects a MODE, not a subset of
+        # processes
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            def handler(payload):
+                if jax.process_count() == 1:
+                    return jnp.sum(payload["x"])
+        """
+        assert rules_of(src) == set()
+
+    def test_def_under_guard_not_flagged(self):
+        # a closure defined under a guard runs on its own schedule
+        src = """
+            import jax
+
+            def start(submit):
+                if jax.process_index() != 0:
+                    return
+
+                def beat():
+                    return _broadcast_json({"op": "ping"})
+                return beat
+        """
+        assert rules_of(src) == set()
+
+    def test_while_loop_guard_is_divergent(self):
+        # a coordinator-only polling loop is the same deadlock shape
+        # as an if-guard, without the if
+        src = """
+            import jax
+
+            def poll(dispatcher, payload):
+                while jax.process_index() == 0:
+                    dispatcher.submit("op", payload)
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_while_else_runs_on_every_process(self):
+        src = """
+            def run(coordinator, log):
+                while coordinator:
+                    log.flush()
+                else:
+                    _broadcast_json({"op": "sync"})
+        """
+        assert rules_of(src) == set()
+
+    def test_conditional_expression_guard_is_divergent(self):
+        src = """
+            def run(model, coordinator):
+                gathered = gather_model(model) if coordinator else None
+                return gathered
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_short_circuit_and_guard_is_divergent(self):
+        # `coordinator and gather(...)`: short-circuiting makes the
+        # collective coordinator-only with no if statement at all
+        src = """
+            def run(model, coordinator):
+                ok = coordinator and gather_model(model)
+                return ok
+        """
+        assert "LO101" in rules_of(src)
+
+    def test_short_circuit_collective_before_guard_is_fine(self):
+        # evaluation order matters: the collective runs on EVERY
+        # process here, the divergent name only gates the result
+        src = """
+            def run(model, coordinator):
+                ok = gather_model(model) and coordinator
+                return ok
+        """
+        assert rules_of(src) == set()
+
+    def test_nested_guards_report_once(self):
+        # guards nesting through a non-If compound statement must not
+        # double-count one defect (one finding, two baseline entries)
+        src = """
+            import jax.numpy as jnp
+
+            def handler(payload, coordinator, write_outputs, lock):
+                if coordinator:
+                    with lock:
+                        if write_outputs:
+                            return jnp.sum(payload["x"])
+        """
+        found = [f for f in findings_for(src) if f.rule == "LO101"]
+        assert len(found) == 1
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            def shutdown(coordinator):
+                if coordinator:
+                    _broadcast_json({"op": "x"})  # lo: allow[LO101]
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO102 — broadcast determinism
+# --------------------------------------------------------------------
+
+
+class TestLO102BroadcastDeterminism:
+    def test_wall_clock_through_assignment_into_submit(self):
+        # the shape of the ml/builder.py trace-dir bug (this rule's
+        # motivating example): a wall-clock value laundered through an
+        # f-string and a dict before reaching the payload
+        src = """
+            import time
+
+            def run(dispatcher):
+                stamp = int(time.time() * 1000)
+                payload = {"dir": f"build_{stamp}"}
+                dispatcher.submit("build_model", payload)
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_unseeded_random_direct_into_broadcast(self):
+        src = """
+            import random
+
+            def run():
+                _broadcast_json({"seed": random.random()})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_unseeded_default_rng_flagged(self):
+        src = """
+            import numpy as np
+
+            def run():
+                _broadcast_json({"draw": np.random.default_rng().random()})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_assigned_unseeded_rng_flagged_through_method_call(self):
+        # the common spelling: construct once, draw later — receiver
+        # taint must ride through the method call
+        src = """
+            import numpy as np
+
+            def run():
+                rng = np.random.default_rng()
+                _broadcast_json({"draw": rng.random()})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_set_iteration_order_flagged(self):
+        src = """
+            def run(names):
+                _broadcast_json(list(set(names)))
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_tuple_assignment_carries_taint(self):
+        # the motivating bug spelled as a tuple assign must not slip
+        # through the single-Name fast path
+        src = """
+            import time
+
+            def run(dispatcher):
+                stamp, other = time.time(), 1
+                dispatcher.submit("op", {"t": stamp})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_tuple_assignment_untainted_element_is_fine(self):
+        src = """
+            import time
+
+            def run(dispatcher):
+                stamp, other = time.time(), 1
+                dispatcher.submit("op", {"n": other})
+        """
+        assert rules_of(src) == set()
+
+    def test_unpacking_single_tainted_value_taints_all_names(self):
+        src = """
+            import time
+
+            def run(dispatcher):
+                minutes, seconds = divmod(time.time(), 60)
+                _broadcast_json({"s": seconds})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_for_tuple_target_carries_set_iteration_taint(self):
+        src = """
+            def run(pairs):
+                for key, value in set(pairs):
+                    _broadcast_json({"k": key})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_rebind_inside_branch_clears_taint_before_sink(self):
+        # the sink sees the env AFTER the branch's own rebind — a
+        # false positive here would hard-fail the deploy preflight on
+        # correct code
+        src = """
+            import time
+
+            def run(cond):
+                x = time.time()
+                if cond:
+                    x = 1
+                    _broadcast_json({"op": x})
+        """
+        assert rules_of(src) == set()
+
+    def test_sink_after_branch_still_sees_outer_taint(self):
+        src = """
+            import time
+
+            def run(cond):
+                x = time.time()
+                if cond:
+                    pass
+                _broadcast_json({"op": x})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_taint_from_one_branch_survives_the_join(self):
+        # conditionally tainted IS tainted: one process takes the
+        # clock branch, another doesn't — the payloads diverge
+        src = """
+            import time
+
+            def run(cond):
+                if cond:
+                    x = time.time()
+                else:
+                    x = 1
+                _broadcast_json({"t": x})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_branch_rebind_does_not_erase_fallthrough_taint(self):
+        src = """
+            import time
+
+            def run(cond):
+                x = time.time()
+                if cond:
+                    x = 1
+                _broadcast_json({"t": x})
+        """
+        assert "LO102" in rules_of(src)
+
+    def test_rebind_on_every_path_clears_taint(self):
+        src = """
+            import time
+
+            def run(cond):
+                x = time.time()
+                if cond:
+                    x = 1
+                else:
+                    x = 2
+                _broadcast_json({"t": x})
+        """
+        assert rules_of(src) == set()
+
+    def test_sorted_set_is_deterministic(self):
+        src = """
+            def run(names):
+                _broadcast_json(sorted(set(names)))
+        """
+        assert rules_of(src) == set()
+
+    def test_seeded_rng_is_fine(self):
+        src = """
+            import numpy as np
+
+            def run(seed):
+                rng = np.random.default_rng(seed)
+                _broadcast_json({"draw": float(rng.random())})
+        """
+        assert rules_of(src) == set()
+
+    def test_clock_used_locally_is_fine(self):
+        src = """
+            import time
+
+            def run(dispatcher, payload):
+                start = time.time()
+                dispatcher.submit("op", payload)
+                return time.time() - start
+        """
+        assert rules_of(src) == set()
+
+    def test_non_dispatcher_submit_not_a_sink(self):
+        src = """
+            import time
+
+            def run(pool, fit):
+                pool.submit(fit, time.time())
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO103 — trace safety
+# --------------------------------------------------------------------
+
+
+class TestLO103TraceSafety:
+    def test_float_on_traced_value_in_jit(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def fn(x):
+                return float(x.sum())
+        """
+        assert "LO103" in rules_of(src)
+
+    def test_item_and_print_in_partial_jit(self):
+        src = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("n",))
+            def fn(x, n):
+                print(x)
+                return x.item()
+        """
+        findings = findings_for(src)
+        assert sum(f.rule == "LO103" for f in findings) == 2
+
+    def test_numpy_call_in_jit_wrapped_function(self):
+        src = """
+            import jax
+            import numpy as np
+
+            def fn(x):
+                return np.asarray(x)
+
+            fast = jax.jit(fn)
+        """
+        assert "LO103" in rules_of(src)
+
+    def test_nested_def_inside_jit_is_traced_too(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def outer(x):
+                def inner(v):
+                    return float(v)
+                return inner(x)
+        """
+        assert "LO103" in rules_of(src)
+
+    def test_static_shape_math_is_fine(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def fn(x):
+                n = int(x.shape[0] * 2)
+                m = float(len(x.shape))
+                return x.reshape(n // 2, -1) * m
+        """
+        assert rules_of(src) == set()
+
+    def test_same_calls_outside_jit_are_fine(self):
+        src = """
+            import numpy as np
+
+            def host_fn(x):
+                print(x)
+                return float(np.asarray(x).sum())
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO104 — dtype hygiene
+# --------------------------------------------------------------------
+
+
+class TestLO104DtypeHygiene:
+    def test_np_float64_in_jit(self):
+        src = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def fn(x):
+                return x.astype(np.float64)
+        """
+        assert "LO104" in rules_of(src)
+
+    def test_float64_string_dtype_in_jit(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fn(n):
+                return jnp.zeros(3, dtype="float64")
+        """
+        assert "LO104" in rules_of(src)
+
+    def test_jnp_float64_dtype_outside_jit(self):
+        # op-by-op dispatch is device code even without @jit
+        src = """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def fn(values):
+                return jnp.asarray(values, dtype=np.float64)
+        """
+        assert "LO104" in rules_of(src)
+
+    def test_host_side_float64_is_fine(self):
+        # the store's column format IS float64 — host paths are exempt
+        src = """
+            import numpy as np
+
+            def to_column(values):
+                return np.asarray(values, dtype=np.float64)
+        """
+        assert rules_of(src) == set()
+
+    def test_default_dtypes_in_jit_are_fine(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fn(x):
+                return jnp.zeros_like(x) + jnp.float32(1.0)
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# CLI contract + baseline workflow
+# --------------------------------------------------------------------
+
+_BAD_MODULE = """\
+import time
+
+def run(dispatcher):
+    dispatcher.submit("op", {"stamp": time.time()})
+"""
+
+
+_BAD_BY_RULE = {
+    "LO101": (
+        "import jax.numpy as jnp\n"
+        "def handler(payload, coordinator):\n"
+        "    if coordinator:\n"
+        "        return jnp.sum(payload['x'])\n"
+    ),
+    "LO102": _BAD_MODULE,
+    "LO103": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def fn(x):\n"
+        "    return float(x.sum())\n"
+    ),
+    "LO104": (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "def fn(v):\n"
+        "    return jnp.asarray(v, dtype=np.float64)\n"
+    ),
+}
+
+
+class TestCli:
+    @pytest.mark.parametrize("rule", sorted(_BAD_BY_RULE))
+    def test_each_rule_family_fails_the_cli(self, rule, tmp_path, capsys):
+        path = tmp_path / "bad.py"
+        path.write_text(_BAD_BY_RULE[rule])
+        assert cli_main([str(path)]) == 1
+        assert rule in capsys.readouterr().out
+
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        path = tmp_path / "clean.py"
+        path.write_text("def fn():\n    return 1\n")
+        assert cli_main([str(path)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_findings_exit_nonzero_with_location_format(
+        self, tmp_path, capsys
+    ):
+        path = tmp_path / "bad.py"
+        path.write_text(_BAD_MODULE)
+        assert cli_main([str(path)]) == 1
+        out = capsys.readouterr().out
+        assert ":4: LO102 " in out  # file:line: LOxxx message
+
+    def test_syntax_error_reported_not_crashed(self, tmp_path, capsys):
+        path = tmp_path / "broken.py"
+        path.write_text("def fn(:\n")
+        assert cli_main([str(path)]) == 1
+        assert "LO000" in capsys.readouterr().out
+
+    def test_select_filters_rules(self, tmp_path):
+        path = tmp_path / "bad.py"
+        path.write_text(_BAD_MODULE)
+        assert cli_main([str(path), "--select", "LO103"]) == 0
+        assert cli_main([str(path), "--select", "LO102"]) == 1
+
+    def test_unknown_rule_and_missing_path_are_usage_errors(self, tmp_path):
+        path = tmp_path / "x.py"
+        path.write_text("pass\n")
+        assert cli_main([str(path), "--select", "LO999"]) == 2
+        assert cli_main([str(tmp_path / "missing.py")]) == 2
+
+    def test_select_with_trailing_comma_stays_filtered(self, tmp_path):
+        # "LO103, " must not smuggle in an empty token that
+        # prefix-matches every rule
+        path = tmp_path / "bad.py"
+        path.write_text(_BAD_MODULE)  # violates LO102 only
+        assert cli_main([str(path), "--select", "LO103, "]) == 0
+        assert cli_main([str(path), "--select", " , "]) == 2
+
+    def test_explicit_file_without_py_suffix_is_analyzed(
+        self, tmp_path, capsys
+    ):
+        # a green run that silently skipped the named file would be
+        # worse than a usage error
+        path = tmp_path / "job_script"
+        path.write_text(_BAD_MODULE)
+        assert cli_main([str(path)]) == 1
+        assert "LO102" in capsys.readouterr().out
+
+    def test_write_baseline_with_select_is_refused(self, tmp_path):
+        # a filtered write would truncate other rules' grandfathered
+        # entries and break the next full preflight
+        path = tmp_path / "bad.py"
+        path.write_text(_BAD_MODULE)
+        baseline = tmp_path / "baseline.txt"
+        assert (
+            cli_main(
+                [str(path), "--baseline", str(baseline),
+                 "--write-baseline", "--select", "LO101"]
+            )
+            == 2
+        )
+        assert not baseline.exists()
+
+    def test_missing_explicit_baseline_is_a_usage_error(self, tmp_path):
+        path = tmp_path / "x.py"
+        path.write_text("pass\n")
+        assert (
+            cli_main([str(path), "--baseline", str(tmp_path / "nope.txt")])
+            == 2
+        )
+        # --write-baseline CREATES the file, so a missing path is fine
+        assert (
+            cli_main(
+                [str(path), "--baseline", str(tmp_path / "new.txt"),
+                 "--write-baseline"]
+            )
+            == 0
+        )
+
+    def test_directory_walk_skips_hidden_and_vendored_dirs(
+        self, tmp_path, capsys
+    ):
+        # .venv / build / *.egg-info under an analyzed directory are
+        # third-party or generated code the gate must not lint
+        for vendored in (".venv/site-packages", "build", "pkg.egg-info"):
+            target = tmp_path / vendored
+            target.mkdir(parents=True)
+            (target / "vendored.py").write_text(_BAD_MODULE)
+        (tmp_path / "mine.py").write_text("def fn():\n    return 1\n")
+        assert cli_main([str(tmp_path)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_warn_only_flag_and_env(self, tmp_path, monkeypatch):
+        path = tmp_path / "bad.py"
+        path.write_text(_BAD_MODULE)
+        assert cli_main([str(path), "--warn-only"]) == 0
+        monkeypatch.setenv("LO_ANALYSIS_WARN", "1")
+        assert cli_main([str(path)]) == 0
+        # an explicit "off" value must keep enforcement ON — presence
+        # alone is not consent to skip the gate
+        for off in ("0", "false", "no", "off", " "):
+            monkeypatch.setenv("LO_ANALYSIS_WARN", off)
+            assert cli_main([str(path)]) == 1
+        monkeypatch.delenv("LO_ANALYSIS_WARN")
+        assert cli_main([str(path)]) == 1
+
+    def test_non_utf8_file_is_a_finding_not_a_crash(
+        self, tmp_path, capsys
+    ):
+        path = tmp_path / "latin.py"
+        path.write_bytes(b"# caf\xe9\nx = 1\n")
+        assert cli_main([str(path)]) == 1
+        assert "LO000" in capsys.readouterr().out
+
+    def test_unreadable_file_is_a_finding_not_a_crash(
+        self, tmp_path, capsys, monkeypatch
+    ):
+        # a dangling symlink in the tree must name the file at fault
+        # (and stay downgradable in warn-only mode), not traceback
+        (tmp_path / "x.py").symlink_to(tmp_path / "gone.py")
+        assert cli_main([str(tmp_path)]) == 1
+        assert "LO000" in capsys.readouterr().out
+        assert cli_main([str(tmp_path), "--warn-only"]) == 0
+
+
+class TestBaselineWorkflow:
+    def test_baseline_grandfathers_old_findings_only(
+        self, tmp_path, capsys
+    ):
+        path = tmp_path / "legacy.py"
+        path.write_text(_BAD_MODULE)
+        baseline = tmp_path / "baseline.txt"
+
+        assert (
+            cli_main(
+                [str(path), "--baseline", str(baseline), "--write-baseline"]
+            )
+            == 0
+        )
+        assert baseline.is_file()
+        capsys.readouterr()
+
+        # grandfathered finding no longer fails the build
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 0
+        assert "baselined" in capsys.readouterr().out
+
+        # a NEW finding still fails, even with the baseline present
+        path.write_text(
+            _BAD_MODULE + "\ndef more(d):\n"
+            "    _broadcast_json({'t': time.time()})\n"
+        )
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 1
+
+    def test_baseline_matches_across_cwd_and_path_spelling(
+        self, tmp_path, monkeypatch
+    ):
+        # keys are anchored to the baseline file's directory, so the
+        # same baseline matches whether the analyzer ran from the repo
+        # root (deploy preflight), from pytest's CWD with absolute
+        # paths (the tier-1 gate), or anywhere else
+        project = tmp_path / "project"
+        project.mkdir()
+        path = project / "legacy.py"
+        path.write_text(_BAD_MODULE)
+        baseline = project / "baseline.txt"
+
+        monkeypatch.chdir(project)
+        assert (
+            cli_main(["legacy.py", "--baseline", "baseline.txt",
+                      "--write-baseline"])
+            == 0
+        )
+
+        monkeypatch.chdir(tmp_path)
+        assert (
+            cli_main(["project/legacy.py", "--baseline", str(baseline)])
+            == 0
+        )
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 0
+
+    def test_baseline_survives_line_shifts(self, tmp_path, capsys):
+        # keys are line-number-free for EVERY rule — LO101 messages
+        # must describe the guard by its expression, not its line
+        path = tmp_path / "legacy.py"
+        lo101 = (
+            "import jax.numpy as jnp\n"
+            "def handler(payload, coordinator):\n"
+            "    if coordinator:\n"
+            "        return jnp.sum(payload['x'])\n"
+        )
+        path.write_text(lo101)
+        baseline = tmp_path / "baseline.txt"
+        cli_main([str(path), "--baseline", str(baseline), "--write-baseline"])
+        capsys.readouterr()
+
+        # an unrelated edit shifts everything down two lines
+        path.write_text("import os\nimport sys\n" + lo101)
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 0
+        assert "baselined" in capsys.readouterr().out
+
+    def test_overlapping_paths_do_not_double_report(self, tmp_path):
+        # a directory plus a file inside it must analyze the file
+        # once, or the duplicate of a baselined finding reads as NEW
+        path = tmp_path / "legacy.py"
+        path.write_text(_BAD_MODULE)
+        baseline = tmp_path / "baseline.txt"
+        cli_main([str(path), "--baseline", str(baseline),
+                  "--write-baseline"])
+        assert (
+            cli_main([str(tmp_path), str(path), "--baseline",
+                      str(baseline)])
+            == 0
+        )
+
+    def test_duplicate_of_baselined_pattern_is_new(self, tmp_path):
+        path = tmp_path / "legacy.py"
+        path.write_text(_BAD_MODULE)
+        baseline = tmp_path / "baseline.txt"
+        cli_main([str(path), "--baseline", str(baseline), "--write-baseline"])
+        # a second identical occurrence consumes no baseline entry
+        path.write_text(
+            _BAD_MODULE
+            + '\ndef run2(dispatcher):\n'
+            '    dispatcher.submit("op", {"stamp": time.time()})\n'
+        )
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 1
+
+
+# --------------------------------------------------------------------
+# the gate: the shipped tree must be clean
+# --------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_framework_tree_has_no_findings(self, capsys):
+        """Zero non-baselined findings over every shipped source tree —
+        the PR gate. New intentional violations need an inline
+        ``# lo: allow[LOxxx]`` with a justifying comment."""
+        paths = [
+            os.path.join(_REPO_ROOT, "learningorchestra_tpu"),
+            os.path.join(_REPO_ROOT, "learning_orchestra_client"),
+            os.path.join(_REPO_ROOT, "deploy"),
+        ]
+        exit_code = cli_main(paths)
+        output = capsys.readouterr().out
+        assert exit_code == 0, f"SPMD-safety findings:\n{output}"
+
+    def test_module_cli_entry_point(self):
+        """The documented invocation: ``python -m
+        learningorchestra_tpu.analysis learningorchestra_tpu``."""
+        result = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "learningorchestra_tpu.analysis",
+                "learningorchestra_tpu",
+            ],
+            capture_output=True,
+            text=True,
+            cwd=_REPO_ROOT,
+            timeout=120,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr

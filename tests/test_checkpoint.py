@@ -200,6 +200,41 @@ class TestPhaseTimer:
         assert len(captures) == 1 and captures[0].is_dir()
         assert any(p.is_file() for p in captures[0].rglob("*"))
 
+    def test_unwritable_trace_root_runs_untraced_and_releases_lock(
+        self, store, titanic_csv, tmp_path, monkeypatch
+    ):
+        """Tracing is observability: a bad LO_TRACE_DIR must neither
+        500 the build nor leak _TRACE_LOCK (which would silently
+        disable tracing for the life of the process)."""
+        from learningorchestra_tpu.ml import builder
+
+        TestCheckpointWiring()._ingest(store, titanic_csv)
+        monkeypatch.setenv("LO_TRACE_DIR", str(tmp_path / "traces"))
+
+        def boom(root, name):
+            raise PermissionError(13, "read-only volume", root)
+
+        monkeypatch.setattr(builder, "_next_trace_dir", boom)
+        from tests.test_frame import DOCUMENTED_PREPROCESSOR
+
+        results = builder.build_model(
+            store, "ck_train", "ck_test", DOCUMENTED_PREPROCESSOR, ["nb"]
+        )
+        assert results  # built fine, just untraced
+        assert builder._TRACE_LOCK.acquire(blocking=False)  # not leaked
+        builder._TRACE_LOCK.release()
+
+    def test_next_trace_dir_reserves_by_creating(self, tmp_path):
+        """Claiming a capture dir must create it: an exists() probe
+        would let two processes sharing LO_TRACE_DIR pick the same
+        name."""
+        from learningorchestra_tpu.ml.builder import _next_trace_dir
+
+        first = _next_trace_dir(str(tmp_path), "t")
+        second = _next_trace_dir(str(tmp_path), "t")
+        assert first != second
+        assert os.path.isdir(first) and os.path.isdir(second)
+
     def test_roundtrip_with_non_npz_extension(self, data, tmp_path):
         X, y = data
         model = make_classifier("nb").fit(np.abs(X), y)

@@ -3,7 +3,7 @@
 import numpy as np
 
 from learningorchestra_tpu.core.ingest import ingest_csv, write_ingest_metadata
-from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID
+from learningorchestra_tpu.core.store import ROW_ID
 from learningorchestra_tpu.core.table import ColumnTable, write_table
 
 

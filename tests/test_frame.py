@@ -11,7 +11,6 @@ from learningorchestra_tpu.frame import (
     StringIndexer,
     VectorAssembler,
     col,
-    lit,
     regexp_extract,
     when,
 )

@@ -5,7 +5,6 @@ SURVEY.md §4 calls it the de-facto integration test)."""
 
 import json
 
-import numpy as np
 import pytest
 
 import learningorchestra_tpu.client as lo_client
