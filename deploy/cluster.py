@@ -219,13 +219,113 @@ class Machine:
         if self.proc and self.proc.poll() is None:
             self.proc.terminate()
 
+    def _remote_kill(self) -> None:
+        """Kill the REMOTE stack process group explicitly: terminating
+        the local ``ssh -o BatchMode=yes`` client does NOT signal the
+        remote side (no tty → no SIGHUP), so without this every cluster
+        restart stranded the previous stack.py — and its whole runtime
+        group — on the worker machine."""
+        if self.manifest["transport"] != "ssh":
+            return
+        target = self.plan["ssh"] or self.plan["host"]
+        try:
+            subprocess.run(
+                [
+                    "ssh", "-o", "BatchMode=yes",
+                    "-o", "ConnectTimeout=5",
+                    target,
+                    # the launched command is `exec ... python deploy/stack.py`
+                    # (plan_command); match it, not every python on the box
+                    "pkill -f deploy/stack.py || true",
+                ],
+                timeout=15,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                check=False,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            pass  # machine unreachable: nothing left to kill from here
+
     def stop(self, timeout: float = 15.0) -> None:
         self.terminate()
+        self._remote_kill()
         if self.proc is not None:
             try:
                 self.proc.wait(timeout)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+
+
+# services on their reference ports (learningorchestra_tpu/services);
+# the driver stays import-free of the package so it runs on machines
+# with only the deploy/ tree checked out
+SERVICE_PORTS = (5000, 5001, 5002, 5003, 5004, 5005, 5006)
+
+# the families the cluster summary line aggregates across members
+SUMMARY_FAMILIES = (
+    "lo_http_requests_total",
+    "lo_http_requests_in_flight",
+    "lo_jobs_running",
+    "lo_jobs_total",
+    "lo_spmd_jobs_total",
+    "lo_spmd_watchdog_trips_total",
+    "lo_store_collections",
+    "lo_store_wal_bytes",
+    "lo_store_spill_bytes",
+    "lo_jitcache_persistent_hits",
+    "lo_jitcache_persistent_misses",
+)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Family → summed sample value (labels collapsed; histogram bucket
+    samples skipped — the driver's summary wants totals, not shape)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value_part = line.rsplit(" ", 1)
+            value = float(value_part)
+        except ValueError:
+            continue
+        family = name_part.split("{", 1)[0]
+        if family.endswith("_bucket"):
+            continue
+        out[family] = out.get(family, 0.0) + value
+    return out
+
+
+def scrape_member_metrics(urls: list[str]) -> dict:
+    """Scrape each member's ``/metrics``; unreachable members (worker
+    machines have no REST surface, loopback-bound services aren't
+    visible from the driver) are skipped, not errors."""
+    totals: dict[str, float] = {}
+    reachable = 0
+    for url in urls:
+        try:
+            with urllib.request.urlopen(url + "/metrics", timeout=3) as resp:
+                families = parse_prometheus(resp.read().decode())
+        except (OSError, ValueError):
+            continue
+        reachable += 1
+        for family, value in families.items():
+            totals[family] = totals.get(family, 0.0) + value
+    totals["_members"] = reachable
+    return totals
+
+
+def metrics_summary_line(totals: dict) -> str:
+    parts = [f"members={int(totals.get('_members', 0))}"]
+    for family in SUMMARY_FAMILIES:
+        if family in totals:
+            value = totals[family]
+            short = family[len("lo_"):]
+            parts.append(
+                f"{short}={int(value) if value == int(value) else value}"
+            )
+    return "[cluster] metrics: " + " ".join(parts)
 
 
 def wait_store_health(url: str, timeout: float) -> None:
@@ -290,6 +390,29 @@ def up(manifest: dict, log=print) -> int:
             machine.terminate()
         for machine in machines:
             machine.stop()
+
+    # the head's scrape surface: store server + the seven services (the
+    # latter answer only when LO_HOST exposes them beyond loopback — the
+    # scraper skips silently otherwise). On its OWN thread: a member
+    # dropping packets makes each URL eat the full connect timeout, and
+    # ~24 s of scrape stall inside the supervision loop would delay
+    # dead-machine detection — and the whole-cluster relaunch — by that
+    # much every interval.
+    scrape_urls = [store_url] + [
+        f"http://{manifest['head']['host']}:{port}" for port in SERVICE_PORTS
+    ]
+    scrape_interval = float(os.environ.get("LO_METRICS_INTERVAL_S", "60"))
+
+    def scrape_loop() -> None:
+        while not stopping.wait(scrape_interval):
+            totals = scrape_member_metrics(scrape_urls)
+            if totals.get("_members"):
+                log(metrics_summary_line(totals))
+
+    if scrape_interval > 0:
+        threading.Thread(
+            target=scrape_loop, name="metrics-scrape", daemon=True
+        ).start()
 
     exit_code = 0
     try:

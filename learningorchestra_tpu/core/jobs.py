@@ -24,11 +24,21 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID, DocumentStore
+from learningorchestra_tpu.telemetry import metrics as _metrics
+from learningorchestra_tpu.telemetry import tracing as _tracing
 
 PENDING = "pending"
 RUNNING = "running"
 FINISHED = "finished"
 FAILED = "failed"
+
+
+class DuplicateJobError(ValueError):
+    """The job name is already PENDING/RUNNING. A ValueError subclass so
+    existing ``except ValueError`` duplicate handling keeps working —
+    but catchable specifically, which matters for callers whose job
+    function can itself raise ValueError (the sync model build must not
+    mistake a failed build for "already active" and run it twice)."""
 
 
 @dataclass
@@ -39,6 +49,16 @@ class JobRecord:
     submitted_at: float = field(default_factory=time.time)
     started_at: Optional[float] = None
     ended_at: Optional[float] = None
+    # The request's correlation ID and span tree: submit() binds the
+    # job to a Trace carrying the submitting request's ID, run() opens
+    # the root span, and everything the work emits (PhaseTimer phases,
+    # SPMD dispatch spans) nests under it — served by
+    # GET /jobs/<name>/trace (utils/web.register_job_traces).
+    trace: Optional[_tracing.Trace] = None
+
+    @property
+    def correlation_id(self) -> Optional[str]:
+        return self.trace.correlation_id if self.trace is not None else None
 
     def as_dict(self) -> dict:
         return {
@@ -48,7 +68,13 @@ class JobRecord:
             "submitted_at": self.submitted_at,
             "started_at": self.started_at,
             "ended_at": self.ended_at,
+            "correlation_id": self.correlation_id,
         }
+
+    def trace_dict(self) -> dict:
+        out = self.as_dict()
+        out["trace"] = self.trace.as_dict() if self.trace is not None else None
+        return out
 
 
 class JobManager:
@@ -57,6 +83,18 @@ class JobManager:
         self._jobs: dict[str, JobRecord] = {}
         self._lock = threading.Lock()
         self._events: dict[str, threading.Event] = {}
+        registry = _metrics.global_registry()
+        self._jobs_total = registry.counter(
+            "lo_jobs_total",
+            "Jobs reaching a terminal state",
+            labels=("state",),
+        )
+        self._jobs_running = registry.gauge(
+            "lo_jobs_running", "Jobs currently executing"
+        )
+        self._job_seconds = registry.histogram(
+            "lo_job_duration_seconds", "Job wall-clock, submit to done"
+        )
 
     def submit(
         self,
@@ -70,37 +108,94 @@ class JobManager:
         """Run ``fn`` on the pool. If ``store``/``collection`` are given,
         a failure marks that dataset's metadata ``finished: true`` with an
         ``error`` field so pollers terminate instead of hanging."""
-        record = JobRecord(name=name)
-        with self._lock:
-            existing = self._jobs.get(name)
-            if existing is not None and existing.state in (PENDING, RUNNING):
-                raise ValueError(f"job {name!r} is already {existing.state}")
-            self._jobs[name] = record
-            done = threading.Event()
-            self._events[name] = done
+        record, done = self._register(name)
 
         def run():
-            record.state = RUNNING
-            record.started_at = time.time()
-            try:
-                fn(*args, **kwargs)
-                record.state = FINISHED
-            except Exception as error:
-                record.state = FAILED
-                record.error = f"{type(error).__name__}: {error}"
-                traceback.print_exc()
-                if store is not None and collection is not None:
-                    store.update_one(
-                        collection,
-                        {ROW_ID: METADATA_ID},
-                        {"finished": True, "error": record.error},
-                    )
-            finally:
-                record.ended_at = time.time()
-                done.set()
+            self._run_tracked(record, done, fn, args, kwargs, store, collection)
 
         self._pool.submit(run)
         return record
+
+    def run_inline(
+        self,
+        name: str,
+        fn: Callable,
+        *args,
+        store: Optional[DocumentStore] = None,
+        collection: Optional[str] = None,
+        **kwargs,
+    ) -> JobRecord:
+        """Run ``fn`` synchronously but with the full job bookkeeping —
+        state record, correlation-ID trace, metrics. This is how the
+        reference-parity SYNCHRONOUS model build (201 only after all
+        fits) still gets a ``/jobs/<name>/trace`` span tree. The
+        caller's exception propagates after the record is finalized."""
+        record, done = self._register(name)
+        self._run_tracked(
+            record, done, fn, args, kwargs, store, collection, reraise=True
+        )
+        return record
+
+    def _register(self, name: str) -> tuple[JobRecord, threading.Event]:
+        record = JobRecord(
+            name=name,
+            trace=_tracing.Trace(
+                # a job submitted from a REST handler inherits the
+                # request's correlation ID; elsewhere a fresh one
+                _tracing.current_correlation_id(),
+                name=name,
+            ),
+        )
+        with self._lock:
+            existing = self._jobs.get(name)
+            if existing is not None and existing.state in (PENDING, RUNNING):
+                raise DuplicateJobError(
+                    f"job {name!r} is already {existing.state}"
+                )
+            self._jobs[name] = record
+            done = threading.Event()
+            self._events[name] = done
+        return record, done
+
+    def _run_tracked(
+        self,
+        record: JobRecord,
+        done: threading.Event,
+        fn: Callable,
+        args: tuple,
+        kwargs: dict,
+        store: Optional[DocumentStore],
+        collection: Optional[str],
+        reraise: bool = False,
+    ) -> None:
+        record.state = RUNNING
+        record.started_at = time.time()
+        self._jobs_running.inc()
+        try:
+            with _tracing.activate(record.trace), _tracing.span(
+                f"job:{record.name}"
+            ):
+                fn(*args, **kwargs)
+            record.state = FINISHED
+        except Exception as error:
+            record.state = FAILED
+            record.error = f"{type(error).__name__}: {error}"
+            if not reraise:
+                traceback.print_exc()
+            if store is not None and collection is not None:
+                store.update_one(
+                    collection,
+                    {ROW_ID: METADATA_ID},
+                    {"finished": True, "error": record.error},
+                )
+            if reraise:
+                raise
+        finally:
+            record.ended_at = time.time()
+            self._jobs_running.dec()
+            self._jobs_total.labels(record.state).inc()
+            self._job_seconds.observe(record.ended_at - record.started_at)
+            done.set()
 
     def get(self, name: str) -> Optional[JobRecord]:
         with self._lock:

@@ -807,6 +807,17 @@ class InMemoryStore(DocumentStore):
                 finally:
                     self._wal = open(path, "a", encoding="utf-8")
             self._collections.clear()
+            # The cleared collections' spill files are dead weight after
+            # a resync (the rebuilt columns are resident); leaving the
+            # folder mappings would also mis-route a NEW collection of
+            # the same name into a folder full of stale files. rmtree
+            # and forget them — every follower resync used to leak both.
+            if self._spill_folders:
+                import shutil
+
+                for folder in self._spill_folders.values():
+                    shutil.rmtree(folder, ignore_errors=True)
+                self._spill_folders.clear()
             if self._wal_buffer is not None:
                 self._wal_buffer[:] = list(lines)
             for line in lines:
@@ -1153,6 +1164,39 @@ class InMemoryStore(DocumentStore):
         )
 
     # --- DocumentStore implementation -----------------------------------------
+    def telemetry_stats(self) -> dict:
+        """Occupancy for /metrics (telemetry.register_store): collection
+        count, on-disk WAL bytes, and bytes currently spilled to
+        disk-backed mappings. File sizes are read at scrape time — cheap
+        next to a scrape interval, and always truthful after compaction
+        or resync rewrites."""
+        with self._lock:
+            collections = len(self._collections)
+            wal = self._wal
+            folders = list(self._spill_folders.values())
+        wal_bytes = 0
+        if wal is not None:
+            try:
+                wal_bytes = os.fstat(wal.fileno()).st_size
+            except (OSError, ValueError):  # closed mid-resync
+                pass
+        spill_bytes = 0
+        for folder in folders:
+            try:
+                with os.scandir(folder) as entries:
+                    for entry in entries:
+                        try:
+                            spill_bytes += entry.stat().st_size
+                        except OSError:
+                            continue
+            except OSError:
+                continue
+        return {
+            "collections": collections,
+            "wal_bytes": wal_bytes,
+            "spill_bytes": spill_bytes,
+        }
+
     def list_collections(self) -> list[str]:
         with self._lock:
             return list(self._collections.keys())

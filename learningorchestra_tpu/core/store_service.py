@@ -114,6 +114,11 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     the reference delegates to Mongo's replica-set election
     (docker-compose.yml:27-91)."""
     app = WebApp("store")
+    # the store SERVER scrapes its own occupancy (collections, WAL
+    # bytes, spill bytes) at GET /metrics; remote-store CLIENTS don't
+    from learningorchestra_tpu.telemetry import register_store
+
+    register_store(store)
     role = role if role is not None else {"writable": True, "poller": None}
     role.setdefault("term", 1 if role.get("writable", True) else 0)
     # serializes promote/demote transitions (HTTP promote vs the
@@ -440,7 +445,7 @@ class RemoteStore(DocumentStore):
             )
         response.raise_for_status()
 
-    def _send(self, send, retry: bool = True):
+    def _send(self, send, retry: bool = True, landed_ok: bool = False):
         """Issue ``send(base_url)``, re-pointing at the writable peer on
         connection failure or a follower's 503.
 
@@ -449,13 +454,19 @@ class RemoteStore(DocumentStore):
         death could duplicate rows, so those surface the original error
         instead. Everything else is the store's idempotent contract
         surface (inserts at explicit ids, set_column at a start_id,
-        reads): a write that landed before the old primary died
-        re-raises as the same duplicate-id KeyError a doubled local
-        call would, so callers see identical semantics either way. The
-        probe loop rides out the auto-promote window
-        (LO_FAILOVER_TIMEOUT_S)."""
+        reads). The probe loop rides out the auto-promote window
+        (LO_FAILOVER_TIMEOUT_S).
+
+        ``landed_ok=True`` marks explicit-id writes, and means: a
+        duplicate-id 409 on an attempt that FOLLOWS an ambiguous
+        failure (connection death / timeout mid-request) is the write
+        we just sent having already landed before the old primary died
+        — treat it as success instead of raising ``KeyError``, so a
+        long chunked ingest survives a failover mid-batch. A 409 on a
+        clean first attempt is a genuine duplicate and still raises."""
         import time
 
+        ambiguous = False  # a send died mid-request: it may have landed
         try:
             response = send(self.base_url)
             # a 503 is a CLEAN rejection (nothing was applied), so even
@@ -467,11 +478,12 @@ class RemoteStore(DocumentStore):
             last_error: Optional[Exception] = None
         # Timeout included: a partitioned/hung primary raises ReadTimeout
         # (not a ConnectionError subclass) and must also re-point —
-        # explicit-id retries stay safe either way (duplicate-id KeyError
-        # if the write had landed)
+        # explicit-id retries stay safe either way (duplicate-id 409 if
+        # the write had landed, swallowed below under landed_ok)
         except (requests.ConnectionError, requests.Timeout) as error:
             if len(self.urls) == 1 or not retry:
                 raise
+            ambiguous = True
             last_error = error
         deadline = time.monotonic() + self.failover_timeout
         while True:
@@ -491,10 +503,19 @@ class RemoteStore(DocumentStore):
                         # ambiguously mid-request: a non-idempotent call
                         # must not be replayed again
                         raise
+                    ambiguous = True
                     last_error = error
                     continue  # just died too; try the next
                 if response.status_code != 503:
                     self.base_url = url
+                    if (
+                        ambiguous
+                        and landed_ok
+                        and response.status_code == 409
+                    ):
+                        # the ids we just re-sent are already present:
+                        # the pre-failover attempt landed — success
+                        return response
                     self._raise_for(response)
                     return response
             if time.monotonic() > deadline:
@@ -506,7 +527,13 @@ class RemoteStore(DocumentStore):
                 )
             time.sleep(0.3)
 
-    def _post(self, path: str, body: dict, retry: bool = True) -> dict:
+    def _post(
+        self,
+        path: str,
+        body: dict,
+        retry: bool = True,
+        landed_ok: bool = False,
+    ) -> dict:
         data = json.dumps(body)
         return self._send(
             lambda base: self._session.post(
@@ -516,16 +543,20 @@ class RemoteStore(DocumentStore):
                 timeout=self.timeout,
             ),
             retry=retry,
+            landed_ok=landed_ok,
         ).json()
 
-    def _post_frame(self, path: str, frame: bytes) -> dict:
+    def _post_frame(
+        self, path: str, frame: bytes, landed_ok: bool = False
+    ) -> dict:
         return self._send(
             lambda base: self._session.post(
                 f"{base}{path}",
                 data=frame,
                 headers={"Content-Type": BIN_CONTENT_TYPE},
                 timeout=self.timeout,
-            )
+            ),
+            landed_ok=landed_ok,
         ).json()
 
     def _post_for_frame(self, path: str, body: dict):
@@ -574,13 +605,16 @@ class RemoteStore(DocumentStore):
             f"/c/{collection}/insert_one",
             {"document": document},
             retry="_id" in document,
+            landed_ok="_id" in document,
         )
 
     def insert_many(self, collection: str, documents: list[dict]) -> None:
+        explicit = all("_id" in document for document in documents)
         self._post(
             f"/c/{collection}/insert_many",
             {"documents": documents},
-            retry=all("_id" in document for document in documents),
+            retry=explicit,
+            landed_ok=explicit,
         )
 
     def insert_columns(
@@ -625,6 +659,9 @@ class RemoteStore(DocumentStore):
             self._post_frame(
                 f"/c/{collection}/insert_columns_bin",
                 encode_frame(chunk, extra=extra),
+                # chunks at an explicit start_id: a duplicate rejection
+                # on the post-failover replay means the chunk landed
+                landed_ok=start_id is not None,
             )
             if stop >= num_rows:
                 break

@@ -31,6 +31,7 @@ from learningorchestra_tpu.core.table import ColumnTable, insert_columns_batched
 from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
+from learningorchestra_tpu.telemetry import tracing as _tracing
 from learningorchestra_tpu.utils.profiling import PhaseTimer, trace
 
 FEATURES_COL = "features"
@@ -298,12 +299,18 @@ def build_model(
     if unknown:
         raise KeyError(f"invalid classificator names {unknown}")
 
-    training_df = load_dataframe(store, training_filename)
-    testing_df = load_dataframe(store, test_filename)
-    out = run_preprocessor(preprocessor_code, training_df, testing_df)
-    out["features_evaluation"] = _alias_if_equal(
-        out["features_evaluation"], out["features_testing"]
-    )
+    # Span-per-stage: with phase spans from each train_one's PhaseTimer
+    # these cover the build end to end, so /jobs/<name>/trace accounts
+    # for (nearly) the whole job wall-clock — the 61%-dtype-cast class
+    # of fact becomes a one-request diagnosis.
+    with _tracing.span("load_data"):
+        training_df = load_dataframe(store, training_filename)
+        testing_df = load_dataframe(store, test_filename)
+    with _tracing.span("preprocess"):
+        out = run_preprocessor(preprocessor_code, training_df, testing_df)
+        out["features_evaluation"] = _alias_if_equal(
+            out["features_evaluation"], out["features_testing"]
+        )
 
     # Multi-host SPMD: every process must dispatch the classifiers'
     # device programs in the SAME order, and thread scheduling is not
@@ -314,7 +321,13 @@ def build_model(
     # past ~1M rows per classifier that can exceed one chip's HBM (the
     # fits are device-queue-serialized anyway, so capping costs little
     # wall-clock; the 10M-row scale proof runs with LO_BUILD_WORKERS=1).
-    if jax.process_count() > 1:
+    # span(devices): the first jax.process_count() call of a process
+    # initializes the device backend — ~100 ms on CPU, whole seconds on
+    # a cold TPU runtime — a real, otherwise-invisible chunk of the
+    # first build's wall-clock that belongs in the trace.
+    with _tracing.span("devices"):
+        multi_process = jax.process_count() > 1
+    if multi_process:
         max_workers = 1
     else:
         max_workers = len(classificators_list) or 1
@@ -375,10 +388,16 @@ def _build_model_traced(
     trace_dir,
 ) -> list[dict]:
     results: list[dict] = []
-    with trace(trace_dir), ThreadPoolExecutor(max_workers=max_workers) as pool:
-        futures = [
-            pool.submit(
-                train_one,
+    # contextvars don't cross pool threads: hand each worker the ambient
+    # (trace, span) so its train span — and the PhaseTimer phases inside
+    # — nest under the request/job trace.
+    context = _tracing.capture()
+
+    def run_train(name: str) -> dict:
+        with _tracing.attach(context), _tracing.span(
+            f"train:{name}", classificator=name
+        ):
+            return train_one(
                 store,
                 name,
                 out["features_training"],
@@ -389,7 +408,10 @@ def _build_model_traced(
                 write_outputs,
                 models_dir,
             )
-            for name in classificators_list
+
+    with trace(trace_dir), ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = [
+            pool.submit(run_train, name) for name in classificators_list
         ]
         wait(futures)
     for future in futures:

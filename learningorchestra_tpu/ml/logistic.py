@@ -55,6 +55,9 @@ def _loss_fn(params, X, y, mask, l2):
 _LBFGS_MEMORY = 10
 _BACKTRACK_STEPS = 4
 _ARMIJO_C1 = 1e-4
+# consecutive sub-tol loss deltas required before an early exit (see
+# the history window in _fit)
+_LR_STOP_DELTAS = 3
 
 
 def _tree_dot(a, b):
@@ -250,10 +253,11 @@ _LR_TOL = 1e-6
 
 def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
     """L-BFGS fit in watchdog-safe segments (see base.segment_steps),
-    stopping once the objective improves by less than ``tol`` across a
-    whole segment — MLlib's tol semantics at segment granularity (at
-    most one segment of extra iterations vs a per-iteration check, and
-    only one scalar crosses the wire per segment)."""
+    stopping once the objective's per-iteration improvement stays under
+    ``tol`` for several consecutive iterations (crossing segment
+    boundaries) — MLlib's tol semantics made robust to a single stalled
+    line-search step, checked at segment granularity so only one loss
+    array crosses the wire per segment."""
     from learningorchestra_tpu.ml.base import largest_divisor, segment_steps
 
     if max_iter <= 0:  # MLlib allows maxIter=0: the initial model
@@ -270,7 +274,14 @@ def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
             iters = capped
     opt_state = _lbfgs_state(params)
     losses = []
-    previous = None
+    # Trailing pre-step losses across segment boundaries: convergence
+    # requires EVERY delta in this window to be small, not just the
+    # final two — a single floor-step Armijo iteration (step clamped to
+    # 1/16, objective barely moves once) used to match the two-point
+    # check and stop a fit mid-descent (ADVICE r5). Window of 3 deltas:
+    # three consecutive sub-tol improvements is a plateau, one is noise.
+    history: list[float] = []
+    window = _LR_STOP_DELTAS + 1
     for _ in range(max_iter // iters):
         params, opt_state, segment_losses = _fit_segment(
             params, opt_state, X, y, mask, iters, l2
@@ -278,21 +289,18 @@ def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
         losses.append(segment_losses)
         if tol <= 0:  # explicit "run every iteration"
             continue
-        # The MOST RECENT per-iteration improvement, like Breeze's
-        # per-iteration check (a segment-endpoint delta can stop early
-        # on an oscillating objective whose endpoints happen to match).
         # One host transfer either way: the losses come back as one
         # array.
-        segment_host = np.asarray(segment_losses)
-        last = float(segment_host[-1])
-        before_last = (
-            float(segment_host[-2]) if len(segment_host) > 1 else previous
-        )
-        if before_last is not None and abs(before_last - last) <= (
-            tol * max(abs(last), 1.0)
-        ):
-            break
-        previous = last
+        history.extend(float(v) for v in np.asarray(segment_losses))
+        del history[:-window]
+        if len(history) >= window:
+            last = history[-1]
+            threshold = tol * max(abs(last), 1.0)
+            if all(
+                abs(history[i + 1] - history[i]) <= threshold
+                for i in range(len(history) - 1)
+            ):
+                break
     return params, (
         jnp.concatenate(losses) if len(losses) > 1 else losses[0]
     )

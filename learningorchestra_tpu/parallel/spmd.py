@@ -29,14 +29,60 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
+from learningorchestra_tpu.telemetry import metrics as _metrics
+from learningorchestra_tpu.telemetry import tracing as _tracing
+
 _SHUTDOWN_OP = "__shutdown__"
 _PING_OP = "__ping__"
+
+
+_METRICS_CACHE: Optional[dict] = None
+
+
+def _registry_metrics():
+    """Declare-once, cached: _broadcast_json runs on every dispatch AND
+    every idle heartbeat ping — it must not take the registry lock for
+    five re-declarations each time."""
+    global _METRICS_CACHE
+    if _METRICS_CACHE is not None:
+        return _METRICS_CACHE
+    registry = _metrics.global_registry()
+    _METRICS_CACHE = _build_metrics(registry)
+    return _METRICS_CACHE
+
+
+def _build_metrics(registry):
+    return {
+        "jobs": registry.counter(
+            "lo_spmd_jobs_total",
+            "SPMD jobs dispatched, by op and outcome",
+            labels=("op", "outcome"),
+        ),
+        "seconds": registry.histogram(
+            "lo_spmd_job_duration_seconds",
+            "Coordinator-observed SPMD job wall-clock",
+            labels=("op",),
+        ),
+        "broadcast_bytes": registry.counter(
+            "lo_spmd_broadcast_bytes_total",
+            "Payload bytes broadcast from the coordinator",
+        ),
+        "watchdog_trips": registry.counter(
+            "lo_spmd_watchdog_trips_total",
+            "Jobs killed by the dispatch watchdog",
+        ),
+        "poisoned": registry.gauge(
+            "lo_spmd_poisoned",
+            "1 once the collective stream is desynchronized",
+        ),
+    }
 
 
 class SpmdJobError(RuntimeError):
@@ -69,6 +115,7 @@ def _broadcast_json(obj: Any = None) -> Any:
     payload = b""
     if jax.process_index() == 0:
         payload = json.dumps(obj).encode()
+        _registry_metrics()["broadcast_bytes"].inc(len(payload))
     length = multihost_utils.broadcast_one_to_all(
         np.array([len(payload)], np.int32)
     )
@@ -90,6 +137,19 @@ class SpmdDispatcher:
         self._lock = threading.Lock()
         self._poisoned: Optional[str] = None  # reason, once broken
         self._stop_heartbeat = threading.Event()
+        self._metrics = _registry_metrics()
+
+    def _poison(self, reason: str) -> None:
+        self._poisoned = reason
+        self._metrics["poisoned"].set(1)
+
+    def _observe(self, op: str, outcome: str, started: float) -> None:
+        if op == _PING_OP:  # keepalives would swamp the job series
+            return
+        self._metrics["jobs"].labels(op, outcome).inc()
+        self._metrics["seconds"].labels(op).observe(
+            time.perf_counter() - started
+        )
 
     def start_heartbeat(self, interval: Optional[float] = None) -> None:
         """Coordinator-side idle keepalive. A waiting worker is not
@@ -145,8 +205,25 @@ class SpmdDispatcher:
         policy rebuilds the runtime.
         """
         handler = self._handlers[op]
+        # The request's correlation ID rides the broadcast envelope so
+        # worker-side spans/logs are attributable to the REST request
+        # that caused them. It is read ONCE here on the coordinator and
+        # broadcast — every process sees the same value (LO102-safe).
+        envelope = {
+            "op": op,
+            "payload": payload,
+            "cid": _tracing.current_correlation_id(),
+        }
+        started = time.perf_counter()
         if jax.process_count() == 1:
-            return handler(payload)
+            with _tracing.span(f"spmd:{op}"):
+                try:
+                    result = handler(payload)
+                except BaseException:
+                    self._observe(op, "error", started)
+                    raise
+            self._observe(op, "ok", started)
+            return result
         if timeout is None:
             timeout = float(os.environ.get("LO_SPMD_TIMEOUT_S", "3600") or 0)
         if self._poisoned:
@@ -155,25 +232,33 @@ class SpmdDispatcher:
             if self._poisoned:
                 raise SpmdRuntimePoisonedError(self._poisoned)
             if not timeout:
-                _broadcast_json({"op": op, "payload": payload})
-                try:
-                    return handler(payload)
-                except BaseException as error:
-                    # same poisoning as the watchdog path: workers die
-                    # on in-job exceptions, the stream is broken
-                    self._poisoned = (
-                        f"SPMD job {op!r} failed mid-collective: {error}"
-                    )
-                    raise
+                with _tracing.span(f"spmd:{op}"):
+                    _broadcast_json(envelope)
+                    try:
+                        result = handler(payload)
+                    except BaseException as error:
+                        # same poisoning as the watchdog path: workers die
+                        # on in-job exceptions, the stream is broken
+                        self._poison(
+                            f"SPMD job {op!r} failed mid-collective: {error}"
+                        )
+                        self._observe(op, "error", started)
+                        raise
+                self._observe(op, "ok", started)
+                return result
             box: dict[str, Any] = {}
             done = threading.Event()
+            context = _tracing.capture()
 
             def run() -> None:
                 try:
                     # the broadcast is inside the watchdog too: with a
                     # dead worker it can block just like the collectives
-                    _broadcast_json({"op": op, "payload": payload})
-                    box["result"] = handler(payload)
+                    with _tracing.attach(context), _tracing.span(
+                        f"spmd:{op}"
+                    ):
+                        _broadcast_json(envelope)
+                        box["result"] = handler(payload)
                 except BaseException as error:  # noqa: BLE001 — re-raised
                     box["error"] = error
                 finally:
@@ -184,19 +269,23 @@ class SpmdDispatcher:
             )
             thread.start()
             if not done.wait(timeout):
-                self._poisoned = (
+                self._metrics["watchdog_trips"].inc()
+                self._poison(
                     f"SPMD job {op!r} timed out after {timeout:.0f}s — a "
                     "worker likely died mid-job; the runtime must be "
                     "restarted (supervisor restart policy)"
                 )
+                self._observe(op, "timeout", started)
                 raise SpmdTimeoutError(self._poisoned)
             if "error" in box:
                 # an exception mid-job kills the workers by design
                 # (run_worker_loop): the runtime is no longer usable
-                self._poisoned = (
+                self._poison(
                     f"SPMD job {op!r} failed mid-collective: {box['error']}"
                 )
+                self._observe(op, "error", started)
                 raise box["error"]
+            self._observe(op, "ok", started)
             return box["result"]
 
     def run_worker_loop(self) -> None:
@@ -212,15 +301,29 @@ class SpmdDispatcher:
             job = _broadcast_json()
             if job["op"] == _SHUTDOWN_OP:
                 return
+            # Worker-side spans carry the COORDINATOR's correlation ID
+            # (from the broadcast envelope): one request, one ID, across
+            # every host. The finished trace parks in the in-process
+            # ring (tracing.remember_trace) and the ID is logged so
+            # worker stdout lines correlate with the coordinator's
+            # /jobs/<name>/trace output.
+            trace = _tracing.Trace(job.get("cid"), name=f"spmd:{job['op']}")
             try:
-                self._handlers[job["op"]](job["payload"])
+                with _tracing.activate(trace), _tracing.span(
+                    f"spmd:{job['op']}", process=jax.process_index()
+                ):
+                    self._handlers[job["op"]](job["payload"])
             except Exception:
                 print(
                     f"[spmd worker {jax.process_index()}] job "
-                    f"{job['op']!r} failed:\n{traceback.format_exc()}",
+                    f"{job['op']!r} (cid {trace.correlation_id}) failed:\n"
+                    f"{traceback.format_exc()}",
                     flush=True,
                 )
                 raise
+            finally:
+                if job["op"] != _PING_OP:
+                    _tracing.remember_trace(trace)
 
     def shutdown_workers(self) -> None:
         self._stop_heartbeat.set()

@@ -16,6 +16,12 @@ strings — reference files cited per module):
 Each module exposes ``create_app(store, ...) -> WebApp``; the reference's
 per-service Flask processes map to ``services.runner`` which serves any
 subset against a shared store.
+
+Beyond the reference surface, every service answers ``GET /metrics``
+(Prometheus text exposition — request counts/latency, job states,
+jitcache hit/miss, store occupancy; see docs/observability.md), and the
+job-bearing services (database_api, model_builder) answer
+``GET /jobs/<name>/trace`` with the job's correlated span tree.
 """
 
 DATABASE_API_PORT = 5000

@@ -25,6 +25,7 @@ from learningorchestra_tpu.core.store import (
     UnsupportedQueryError,
     parse_query,
 )
+from learningorchestra_tpu.telemetry import register_store
 from learningorchestra_tpu.utils.web import WebApp
 
 MESSAGE_RESULT = "result"
@@ -36,6 +37,9 @@ PAGINATE_FILE_LIMIT = 20
 def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     app = WebApp("database_api")
     jobs = jobs or JobManager()
+    register_store(store)
+    # GET /jobs/<name>/trace — the ingest job's correlated span tree
+    app.register_job_traces(jobs)
 
     @app.route("/files", methods=("POST",))
     def create_file(request):

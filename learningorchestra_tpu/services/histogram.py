@@ -10,6 +10,7 @@ from __future__ import annotations
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ops.histogram import create_histogram
 from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.telemetry import register_store, span
 from learningorchestra_tpu.utils.web import WebApp
 
 MESSAGE_RESULT = "result"
@@ -18,6 +19,7 @@ MESSAGE_CREATED_FILE = "created_file"
 
 def create_app(store: DocumentStore) -> WebApp:
     app = WebApp("histogram")
+    register_store(store)
 
     @app.route("/histograms/<parent_filename>", methods=("POST",))
     def create_histogram_route(request, parent_filename):
@@ -39,7 +41,10 @@ def create_app(store: DocumentStore) -> WebApp:
         if not store.create_collection(histogram_filename):
             return {MESSAGE_RESULT: validators.MESSAGE_HISTOGRAM_DUPLICATE}, 409
         try:
-            create_histogram(store, parent_filename, histogram_filename, list(fields))
+            with span("histogram:compute", parent=parent_filename):
+                create_histogram(
+                    store, parent_filename, histogram_filename, list(fields)
+                )
         except BaseException:
             store.drop(histogram_filename)
             raise

@@ -14,6 +14,7 @@ import os
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ops.images import IMAGE_FORMAT, create_embedding_image
 from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.telemetry import register_store, span
 from learningorchestra_tpu.utils.web import WebApp, send_file
 
 MESSAGE_RESULT = "result"
@@ -38,6 +39,7 @@ def create_app(
     dispatch (parallel/spmd.py) so every process enters the embedding;
     default is the in-process call."""
     app = WebApp(method)
+    register_store(store)
 
     if create is None:
 
@@ -111,7 +113,8 @@ def create_app(
             release_claim(output_filename, keep_png=True)
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
         try:
-            create(parent_filename, label_name, output_filename)
+            with span(f"{method}:embed", parent=parent_filename):
+                create(parent_filename, label_name, output_filename)
         except BaseException:
             release_claim(output_filename, keep_png=False)
             raise

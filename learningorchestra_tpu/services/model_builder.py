@@ -29,6 +29,7 @@ from learningorchestra_tpu.ml.checkpoint import (
     checkpoint_path as _checkpoint_path,
 )
 from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.telemetry import register_store
 from learningorchestra_tpu.utils.web import WebApp
 
 MESSAGE_RESULT = "result"
@@ -56,11 +57,16 @@ def create_app(
     multi-minute build no longer pins a WSGI worker invisibly;
     ``GET /jobs`` on this service reports its state
     (PENDING/RUNNING/FINISHED/FAILED + error payload)."""
-    from learningorchestra_tpu.core.jobs import JobManager
+    from learningorchestra_tpu.core.jobs import DuplicateJobError, JobManager
 
     app = WebApp("model_builder")
     models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
     jobs = jobs or JobManager()
+    register_store(store)
+    # GET /jobs/<name>/trace — a build's span tree: per-classifier train
+    # spans, each nesting the PhaseTimer fit/evaluate/predict/write
+    # phases, all under the request's correlation ID
+    app.register_job_traces(jobs)
 
     def checkpoint_path(name: str) -> str:
         return _checkpoint_path(models_dir, name)
@@ -115,11 +121,11 @@ def create_app(
                 return {
                     MESSAGE_RESULT: validators.MESSAGE_INVALID_CLASSIFICATOR
                 }, 406
+        job_name = (
+            f"build:{body['test_filename']}:"
+            f"{'+'.join(body['classificators_list'])}"
+        )
         if body.get("async"):
-            job_name = (
-                f"build:{body['test_filename']}:"
-                f"{'+'.join(body['classificators_list'])}"
-            )
             try:
                 jobs.submit(job_name, build, body)
             except ValueError as error:  # same job already active
@@ -128,7 +134,21 @@ def create_app(
                 MESSAGE_RESULT: MESSAGE_CREATED_FILE,
                 "job": job_name,
             }, 201
-        build(body)
+        # Synchronous stays the reference contract (201 after ALL fits)
+        # but runs as a TRACKED inline job, so the build still gets a
+        # correlated span tree at /jobs/<name>/trace. A concurrent
+        # same-name sync build falls back to untracked execution rather
+        # than changing the reference's (racy) allow-both behaviour.
+        try:
+            jobs.run_inline(job_name, build, body)
+        except DuplicateJobError:  # already active: reference parity.
+            # NOT a bare ValueError — run_inline re-raises the build's
+            # OWN exceptions, and a build that failed with ValueError
+            # must surface, not silently run a second time.
+            build(body)
+        # response body stays the verbatim reference payload (clients
+        # and the golden tests compare it whole); the job name is
+        # derivable and /jobs lists it
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     @app.route("/jobs", methods=("GET",))

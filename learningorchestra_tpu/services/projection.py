@@ -10,6 +10,7 @@ from __future__ import annotations
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ops.projection import project
 from learningorchestra_tpu.services import validators
+from learningorchestra_tpu.telemetry import register_store, span
 from learningorchestra_tpu.utils.web import WebApp
 
 MESSAGE_RESULT = "result"
@@ -18,6 +19,7 @@ MESSAGE_CREATED_FILE = "created_file"
 
 def create_app(store: DocumentStore) -> WebApp:
     app = WebApp("projection")
+    register_store(store)
 
     @app.route("/projections/<parent_filename>", methods=("POST",))
     def create_projection(request, parent_filename):
@@ -38,7 +40,10 @@ def create_app(store: DocumentStore) -> WebApp:
         if not store.create_collection(projection_filename):
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
         try:
-            project(store, parent_filename, projection_filename, list(fields))
+            with span("projection:project", parent=parent_filename):
+                project(
+                    store, parent_filename, projection_filename, list(fields)
+                )
         except BaseException:
             store.drop(projection_filename)
             raise

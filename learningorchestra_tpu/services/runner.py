@@ -58,6 +58,12 @@ Environment:
 - ``LO_AUTO_PROMOTE_S`` / ``LO_PEERS`` / ``LO_FAILOVER_TIMEOUT_S`` —
   store HA: follower self-promotion, term fencing, and the client-side
   re-point window (core/store_service.py; see deploy/README.md).
+
+Observability: every service (and the store server) answers
+``GET /metrics`` in Prometheus text format, and every request carries an
+``X-Correlation-Id`` that threads REST → job → SPMD broadcast → phase
+spans (``GET /jobs/<name>/trace``) — docs/observability.md has the
+metric catalog and scrape examples.
 """
 
 from __future__ import annotations

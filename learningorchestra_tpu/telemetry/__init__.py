@@ -1,0 +1,44 @@
+"""Process-wide telemetry: a metrics registry and span-based tracing.
+
+The reference's only observability artifact is a wall-clock ``fit_time``
+in prediction metadata (SURVEY.md §5: "Tracing / profiling: absent").
+This package closes the Dapper-style gap: every REST request gets a
+correlation ID (utils/web.py middleware) that rides job records
+(core/jobs.py), the SPMD broadcast envelope (parallel/spmd.py) and
+``PhaseTimer`` phase timings (utils/profiling.py) as a single span tree,
+and every :class:`~learningorchestra_tpu.utils.web.WebApp` exposes a
+``GET /metrics`` Prometheus text endpoint over one process-wide
+registry — stdlib only, no prometheus_client dependency.
+"""
+
+from learningorchestra_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    global_registry,
+    register_store,
+)
+from learningorchestra_tpu.telemetry.tracing import (
+    Span,
+    Trace,
+    activate,
+    attach,
+    capture,
+    current_correlation_id,
+    current_trace,
+    mint_correlation_id,
+    span,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "Span",
+    "Trace",
+    "activate",
+    "attach",
+    "capture",
+    "current_correlation_id",
+    "current_trace",
+    "global_registry",
+    "mint_correlation_id",
+    "register_store",
+    "span",
+]

@@ -1,0 +1,182 @@
+"""Span-based tracing with request correlation IDs.
+
+One :class:`Trace` is the whole story of one request: the REST
+middleware (utils/web.py) mints a correlation ID, the job manager binds
+the job's work to a trace carrying that ID, the SPMD dispatcher rides it
+on the broadcast envelope so worker-side spans are attributable, and
+``PhaseTimer`` phases land as spans — so ``GET /jobs/<name>/trace``
+answers "where did this request's time go" across every layer.
+
+Context propagation is ``contextvars``-based: span nesting follows the
+thread of execution; fan-out threads (the builder's per-classifier pool)
+re-attach with :func:`capture`/:func:`attach` because ``contextvars`` do
+not cross ``ThreadPoolExecutor`` boundaries. :func:`span` is a cheap
+no-op when no trace is active, so instrumented library code costs
+nothing outside a request.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from typing import Iterator, Optional
+
+_TRACE: contextvars.ContextVar[Optional["Trace"]] = contextvars.ContextVar(
+    "lo_trace", default=None
+)
+_SPAN: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "lo_span", default=None
+)
+
+CORRELATION_HEADER = "X-Correlation-Id"
+
+
+def mint_correlation_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One timed operation; children nest within the parent's window."""
+
+    __slots__ = (
+        "name", "start_ts", "duration_s", "meta", "children", "_t0", "_trace"
+    )
+
+    def __init__(self, name: str, trace: "Trace", meta: Optional[dict] = None):
+        self.name = name
+        self.start_ts = time.time()
+        self.duration_s: Optional[float] = None
+        self.meta = meta or {}
+        self.children: list[Span] = []
+        self._t0 = time.perf_counter()
+        self._trace = trace
+
+    def finish(self) -> None:
+        self.duration_s = time.perf_counter() - self._t0
+
+    def as_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "start_ts": round(self.start_ts, 6),
+            "duration_s": (
+                None if self.duration_s is None else round(self.duration_s, 6)
+            ),
+            "children": [child.as_dict() for child in self.children],
+        }
+        if self.meta:
+            out["meta"] = self.meta
+        return out
+
+
+class Trace:
+    """A correlation ID plus its span tree. Thread-safe: fan-out threads
+    attach spans concurrently (ml/builder.py's classifier pool)."""
+
+    def __init__(self, correlation_id: Optional[str] = None, name: str = ""):
+        self.correlation_id = correlation_id or mint_correlation_id()
+        self.name = name
+        self.spans: list[Span] = []
+        self._lock = threading.Lock()
+
+    def _add(self, span_obj: Span, parent: Optional[Span]) -> None:
+        with self._lock:
+            if parent is not None:
+                parent.children.append(span_obj)
+            else:
+                self.spans.append(span_obj)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                "correlation_id": self.correlation_id,
+                "name": self.name,
+                "spans": [span_obj.as_dict() for span_obj in self.spans],
+            }
+
+
+def current_trace() -> Optional[Trace]:
+    return _TRACE.get()
+
+
+def current_correlation_id() -> Optional[str]:
+    trace = _TRACE.get()
+    return trace.correlation_id if trace is not None else None
+
+
+@contextlib.contextmanager
+def activate(trace: Trace) -> Iterator[Trace]:
+    """Make ``trace`` the ambient trace; new spans root at its top."""
+    trace_token = _TRACE.set(trace)
+    span_token = _SPAN.set(None)
+    try:
+        yield trace
+    finally:
+        _SPAN.reset(span_token)
+        _TRACE.reset(trace_token)
+
+
+def capture() -> tuple[Optional[Trace], Optional[Span]]:
+    """Snapshot the ambient (trace, span) for hand-off to a pool thread."""
+    return _TRACE.get(), _SPAN.get()
+
+
+@contextlib.contextmanager
+def attach(
+    context: tuple[Optional[Trace], Optional[Span]]
+) -> Iterator[None]:
+    """Adopt a captured context in another thread: spans opened inside
+    become children of the captured span, in the captured trace."""
+    trace, parent = context
+    trace_token = _TRACE.set(trace)
+    span_token = _SPAN.set(parent)
+    try:
+        yield
+    finally:
+        _SPAN.reset(span_token)
+        _TRACE.reset(trace_token)
+
+
+@contextlib.contextmanager
+def span(name: str, **meta) -> Iterator[Optional[Span]]:
+    """Record a timed span under the ambient trace; no-op without one."""
+    trace = _TRACE.get()
+    if trace is None:
+        yield None
+        return
+    parent = _SPAN.get()
+    span_obj = Span(name, trace, meta=meta or None)
+    trace._add(span_obj, parent)
+    token = _SPAN.set(span_obj)
+    try:
+        yield span_obj
+    finally:
+        span_obj.finish()
+        _SPAN.reset(token)
+
+
+# --- worker-side trace retention -------------------------------------------
+# SPMD worker processes have no REST surface; their traces (attributed by
+# the broadcast correlation ID) park in a bounded ring an operator can
+# dump (parallel/spmd.py logs the correlation id per job, and tests
+# assert attribution through here).
+_RECENT_LIMIT = 256
+_RECENT: "dict[str, Trace]" = {}
+_RECENT_ORDER: list[str] = []
+_RECENT_LOCK = threading.Lock()
+
+
+def remember_trace(trace: Trace) -> None:
+    with _RECENT_LOCK:
+        if trace.correlation_id not in _RECENT:
+            _RECENT_ORDER.append(trace.correlation_id)
+        _RECENT[trace.correlation_id] = trace
+        while len(_RECENT_ORDER) > _RECENT_LIMIT:
+            _RECENT.pop(_RECENT_ORDER.pop(0), None)
+
+
+def recall_trace(correlation_id: str) -> Optional[Trace]:
+    with _RECENT_LOCK:
+        return _RECENT.get(correlation_id)

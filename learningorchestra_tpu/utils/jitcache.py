@@ -58,6 +58,14 @@ def _register_listeners() -> None:
     _LISTENERS_ON = True
 
 
+def raw_stats() -> dict:
+    """Unrounded live counters — what the telemetry registry's jitcache
+    collector reads at scrape time (telemetry/metrics.py). Importing
+    this module stays jax-free until the cache is enabled, so /metrics
+    can report zeros before the first compile."""
+    return dict(_STATS)
+
+
 def cache_stats() -> dict:
     """Snapshot of persistent-cache hits/misses and compile seconds for
     this process, floats pre-rounded for reporting. A miss means the

@@ -14,9 +14,16 @@ import contextlib
 import time
 from typing import Iterator, Optional
 
+from learningorchestra_tpu.telemetry import tracing as _tracing
+
 
 class PhaseTimer:
-    """Accumulates ``{phase: seconds}``; reentrant per phase."""
+    """Accumulates ``{phase: seconds}``; reentrant per phase.
+
+    Each phase also lands as a span in the active trace context (a
+    no-op outside one), so the same ``fit``/``write`` numbers that go to
+    stored metadata appear in the request's correlated span tree
+    (``GET /jobs/<name>/trace``) without double instrumentation."""
 
     def __init__(self):
         self.timings: dict[str, float] = {}
@@ -25,7 +32,8 @@ class PhaseTimer:
     def phase(self, name: str) -> Iterator[None]:
         start = time.perf_counter()
         try:
-            yield
+            with _tracing.span(f"phase:{name}"):
+                yield
         finally:
             elapsed = time.perf_counter() - start
             self.timings[name] = self.timings.get(name, 0.0) + elapsed

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Callable
 
 from werkzeug.exceptions import HTTPException, NotFound
@@ -19,6 +20,9 @@ from werkzeug.routing import Map, Rule
 from werkzeug.serving import make_server
 from werkzeug.test import Client
 from werkzeug.wrappers import Request, Response
+
+from learningorchestra_tpu.telemetry import metrics as _metrics
+from learningorchestra_tpu.telemetry import tracing as _tracing
 
 
 def jsonify(payload: Any) -> Response:
@@ -42,10 +46,49 @@ class WebApp:
     the payload is JSON-serialised.
     """
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, registry=None):
         self.name = name
         self.url_map = Map()
         self._handlers: dict[str, Callable] = {}
+        # Telemetry: every app reports into the process registry (one
+        # shared registry when services co-habit a process — families
+        # are labelled by service) and serves it at GET /metrics.
+        self.registry = registry or _metrics.global_registry()
+        self._requests_total = self.registry.counter(
+            "lo_http_requests_total",
+            "HTTP requests handled",
+            labels=("service", "route", "method", "status"),
+        )
+        self._request_seconds = self.registry.histogram(
+            "lo_http_request_duration_seconds",
+            "Wall-clock per request",
+            labels=("service", "route", "method"),
+        )
+        self._in_flight = self.registry.gauge(
+            "lo_http_requests_in_flight",
+            "Requests currently being handled",
+            labels=("service",),
+        )
+
+        @self.route("/metrics")
+        def serve_metrics(request):
+            return Response(
+                self.registry.render(),
+                content_type=_metrics.CONTENT_TYPE,
+                status=200,
+            )
+
+    def register_job_traces(self, jobs) -> None:
+        """Serve ``GET /jobs/<name>/trace``: the span tree (with the
+        request's correlation ID) of a tracked job — the per-request
+        "where did the time go" answer (core/jobs.py grows the trace)."""
+
+        @self.route("/jobs/<job_name>/trace")
+        def read_job_trace(request, job_name):
+            record = jobs.get(job_name)
+            if record is None:
+                return {"result": "not_found"}, 404
+            return {"result": record.trace_dict()}, 200
 
     def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
         def decorator(handler: Callable) -> Callable:
@@ -60,6 +103,9 @@ class WebApp:
         adapter = self.url_map.bind_to_environ(request.environ)
         try:
             endpoint, args = adapter.match()
+            # the RULE (not the concrete path) labels request metrics, so
+            # /files/<filename> is one series, not one per dataset
+            request.environ["lo.route"] = endpoint.split("|")[1]
         except NotFound:
             return Response(
                 json.dumps({"result": "not_found"}),
@@ -91,12 +137,42 @@ class WebApp:
 
     def __call__(self, environ, start_response):
         request = Request(environ)
+        # Correlation middleware: honour a caller-supplied ID (a client
+        # stitching multi-service flows) or mint one; the request runs
+        # under an active trace so spans anywhere below (job submit,
+        # SPMD dispatch, PhaseTimer phases) correlate, and the ID echoes
+        # back on the response.
+        correlation_id = (
+            request.headers.get(_tracing.CORRELATION_HEADER)
+            or _tracing.mint_correlation_id()
+        )
+        trace = _tracing.Trace(
+            correlation_id, name=f"{request.method} {request.path}"
+        )
+        self._in_flight.labels(self.name).inc()
+        started = time.perf_counter()
         try:
-            response = self._dispatch(request)
-        except Exception as error:  # mirror Flask's 500-with-traceback text
-            response = Response(
-                f"{type(error).__name__}: {error}", status=500, mimetype="text/plain"
-            )
+            with _tracing.activate(trace), _tracing.span(
+                f"http:{request.method} {request.path}"
+            ):
+                try:
+                    response = self._dispatch(request)
+                except Exception as error:  # mirror Flask's 500 text
+                    response = Response(
+                        f"{type(error).__name__}: {error}",
+                        status=500,
+                        mimetype="text/plain",
+                    )
+        finally:
+            self._in_flight.labels(self.name).dec()
+        route = environ.get("lo.route", "<unmatched>")
+        self._requests_total.labels(
+            self.name, route, request.method, response.status_code
+        ).inc()
+        self._request_seconds.labels(
+            self.name, route, request.method
+        ).observe(time.perf_counter() - started)
+        response.headers[_tracing.CORRELATION_HEADER] = correlation_id
         return response(environ, start_response)
 
     def test_client(self) -> Client:

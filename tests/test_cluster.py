@@ -224,3 +224,149 @@ def test_cluster_two_machine_build_and_heal(tmp_path):
             driver.wait(60)
         except subprocess.TimeoutExpired:
             os.killpg(os.getpgid(driver.pid), signal.SIGKILL)
+
+
+# --- driver unit tests (no cluster bring-up) --------------------------------
+def _load_cluster_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lo_deploy_cluster",
+        os.path.join(_REPO_ROOT, "deploy", "cluster.py"),
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _manifest(transport="ssh", env=None):
+    return {
+        "repo": "/opt/my repo",  # space: the quoting under test
+        "python": "python3",
+        "transport": transport,
+        "store_port": 27027,
+        "coord_port": 12355,
+        "env": env or {},
+        "workers": [
+            {
+                "host": "10.0.0.2",
+                "ssh": "user@10.0.0.2",
+                "data_dir": "lo_data",
+                "processes": 1,
+            }
+        ],
+        "restart_delay": 5,
+        "head": {
+            "host": "10.0.0.1",
+            "bind": "0.0.0.0",
+            "ssh": "user@10.0.0.1",
+            "data_dir": "/var/lo data",  # space again
+            "workers": 0,
+        },
+    }
+
+
+class TestPlanCommand:
+    def test_ssh_quoting_and_env_propagation(self):
+        cluster = _load_cluster_module()
+        manifest = _manifest(
+            env={"LO_EXTRA": "a b", "LO_QUOTE": "it's"}
+        )
+        plans = cluster.machine_plans(manifest)
+        head_cmd = cluster.plan_command(manifest, plans[0])
+        assert head_cmd[:3] == ["ssh", "-o", "BatchMode=yes"]
+        assert head_cmd[3] == "user@10.0.0.1"
+        remote = head_cmd[4]
+        # repo path with a space survives the shell round-trip
+        assert "cd '/opt/my repo' && exec env " in remote
+        assert remote.endswith("python3 deploy/stack.py")
+        # every env value shell-quoted exactly once
+        assert "LO_EXTRA='a b'" in remote
+        assert 'LO_QUOTE=\'it\'"\'"\'s\'' in remote
+        assert "LO_DATA_DIR='/var/lo data'" in remote
+        assert "LO_HOST=0.0.0.0" in remote
+        # the worker plan carries the cross-machine wiring computed by
+        # the driver — store URL, coordinator address, process base
+        worker_cmd = cluster.plan_command(manifest, plans[1])
+        worker_remote = worker_cmd[4]
+        assert "LO_STORE_URL=http://10.0.0.1:27027" in worker_remote
+        assert "LO_COORDINATOR=10.0.0.1:12355" in worker_remote
+        assert "LO_PROCESS_BASE=1" in worker_remote
+        assert "LO_EXTRA='a b'" in worker_remote
+        # an ssh target falls back to the manifest host when absent
+        plans[1]["ssh"] = None
+        assert cluster.plan_command(manifest, plans[1])[3] == "10.0.0.2"
+
+    def test_local_transport_runs_stack_directly(self):
+        cluster = _load_cluster_module()
+        manifest = _manifest(transport="local")
+        plan = cluster.machine_plans(manifest)[0]
+        command = cluster.plan_command(manifest, plan)
+        assert command[0] == sys.executable
+        assert command[1].endswith("stack.py")
+
+
+class TestRemoteStop:
+    def test_stop_issues_explicit_remote_kill(self, monkeypatch):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        plan = cluster.machine_plans(manifest)[1]
+        machine = cluster.Machine(manifest, plan, log=lambda *_: None)
+        calls = []
+        monkeypatch.setattr(
+            cluster.subprocess,
+            "run",
+            lambda argv, **kw: calls.append(argv),
+        )
+        machine.stop()  # never started: the remote kill must still fire
+        assert len(calls) == 1
+        argv = calls[0]
+        assert argv[:3] == ["ssh", "-o", "BatchMode=yes"]
+        assert argv[-2] == "user@10.0.0.2"
+        assert "pkill -f deploy/stack.py" in argv[-1]
+
+    def test_local_transport_skips_remote_kill(self, monkeypatch):
+        cluster = _load_cluster_module()
+        manifest = _manifest(transport="local")
+        plan = cluster.machine_plans(manifest)[0]
+        machine = cluster.Machine(manifest, plan, log=lambda *_: None)
+        calls = []
+        monkeypatch.setattr(
+            cluster.subprocess,
+            "run",
+            lambda argv, **kw: calls.append(argv),
+        )
+        machine.stop()
+        assert calls == []
+
+
+class TestMetricsScrape:
+    def test_parse_prometheus_sums_families(self):
+        cluster = _load_cluster_module()
+        text = (
+            "# HELP lo_http_requests_total requests\n"
+            "# TYPE lo_http_requests_total counter\n"
+            'lo_http_requests_total{service="a",status="200"} 3\n'
+            'lo_http_requests_total{service="b",status="500"} 2\n'
+            'lo_http_request_duration_seconds_bucket{le="+Inf"} 5\n'
+            "lo_jobs_running 1\n"
+            "garbage line without value\n"
+        )
+        families = cluster.parse_prometheus(text)
+        assert families["lo_http_requests_total"] == 5
+        assert families["lo_jobs_running"] == 1
+        # histogram buckets are shape, not totals — skipped
+        assert "lo_http_request_duration_seconds_bucket" not in families
+
+    def test_summary_line(self):
+        cluster = _load_cluster_module()
+        line = cluster.metrics_summary_line(
+            {
+                "_members": 2,
+                "lo_http_requests_total": 7.0,
+                "lo_jobs_running": 1.0,
+            }
+        )
+        assert line.startswith("[cluster] metrics: members=2")
+        assert "http_requests_total=7" in line
+        assert "jobs_running=1" in line

@@ -659,3 +659,67 @@ class TestAutoFailover:
         finally:
             a.stop()
             del b_port_probe
+
+
+class TestFailoverLandedWrites:
+    """An explicit-id write that LANDED before the primary died must not
+    fail the client when the failover retry answers duplicate-id
+    (ADVICE r5: long ingests used to die mid-batch on exactly this)."""
+
+    class _R409:
+        status_code = 409
+
+        def json(self):
+            return {"error": "duplicate _id values [1, 2]"}
+
+    def _flaky_send(self):
+        import requests
+
+        calls = []
+
+        def send(base):
+            calls.append(base)
+            if len(calls) == 1:
+                raise requests.ConnectionError("primary died mid-write")
+            return self._R409()
+
+        return send
+
+    def _patched(self, monkeypatch):
+        from learningorchestra_tpu.core import store_service
+
+        monkeypatch.setattr(
+            store_service,
+            "probe_health",
+            lambda url, timeout=2.0: {
+                "ok": True,
+                "writable": url == "http://b",
+            },
+        )
+        return store_service
+
+    def test_duplicate_after_ambiguous_retry_is_success(self, monkeypatch):
+        store_service = self._patched(monkeypatch)
+        store = store_service.RemoteStore(
+            "http://a,http://b", failover_timeout=5
+        )
+        response = store._send(self._flaky_send(), retry=True, landed_ok=True)
+        assert response.status_code == 409  # swallowed: the write landed
+
+    def test_without_landed_ok_duplicate_still_raises(self, monkeypatch):
+        store_service = self._patched(monkeypatch)
+        store = store_service.RemoteStore(
+            "http://a,http://b", failover_timeout=5
+        )
+        with pytest.raises(KeyError):
+            store._send(self._flaky_send(), retry=True, landed_ok=False)
+
+    def test_clean_first_attempt_409_still_raises(self, monkeypatch):
+        # no ambiguity: a 409 on a healthy first attempt is a genuine
+        # duplicate even for landed_ok calls
+        store_service = self._patched(monkeypatch)
+        store = store_service.RemoteStore("http://a", failover_timeout=5)
+        with pytest.raises(KeyError):
+            store._send(
+                lambda base: self._R409(), retry=True, landed_ok=True
+            )
