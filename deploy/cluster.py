@@ -41,6 +41,11 @@ Manifest (JSON)::
       "store_port": 27027,
       "coord_port": 12355,
       "env": {},                   # extra env for every machine
+      "sched": {                   # optional scheduler knobs, validated
+        "job_workers": 8,          #   LO_JOB_WORKERS (host-class width)
+        "device_width": 1,         #   LO_SCHED_DEVICE_WIDTH
+        "queue_cap": 64            #   LO_SCHED_QUEUE_CAP (429 past it)
+      },
       "restart_delay": 5,
       "max_cluster_restarts": null # null = retry forever
     }
@@ -91,7 +96,30 @@ def load_manifest(path: str) -> dict:
         worker.setdefault("data_dir", "lo_data")
     if manifest["transport"] not in ("ssh", "local"):
         raise SystemExit(f"unknown transport {manifest['transport']!r}")
+    sched = manifest.setdefault("sched", {})
+    for key in sched:
+        if key not in _SCHED_KNOBS:
+            raise SystemExit(
+                f"unknown sched knob {key!r} (have: "
+                f"{', '.join(sorted(_SCHED_KNOBS))})"
+            )
+        # bool is an int subclass: `"device_width": true` must fail
+        # here at manifest load, not crash-loop every machine later
+        if (
+            not isinstance(sched[key], int)
+            or isinstance(sched[key], bool)
+            or sched[key] < 1
+        ):
+            raise SystemExit(f"sched.{key} must be a positive integer")
     return manifest
+
+
+# manifest sched.<knob> -> the env var every machine receives
+_SCHED_KNOBS = {
+    "job_workers": "LO_JOB_WORKERS",
+    "device_width": "LO_SCHED_DEVICE_WIDTH",
+    "queue_cap": "LO_SCHED_QUEUE_CAP",
+}
 
 
 def total_processes(manifest: dict) -> int:
@@ -110,6 +138,12 @@ def machine_plans(manifest: dict) -> list[dict]:
     coordinator = f"{head['host']}:{manifest['coord_port']}"
     shared = dict(manifest["env"])
     shared["LO_TOTAL_PROCESSES"] = str(total)
+    # scheduler knobs apply cluster-wide: every machine's services
+    # admit through the same widths/caps (docs/scheduler.md). .get():
+    # callers may hand-build plans without load_manifest's defaults.
+    for knob, env_var in _SCHED_KNOBS.items():
+        if knob in manifest.get("sched", {}):
+            shared[env_var] = str(manifest["sched"][knob])
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 

@@ -14,11 +14,26 @@
 #   LO_DATA_DIR    store WAL directory (default ./lo_data, or $1)
 #   JAX_PLATFORMS  accelerator choice  (default: jax autodetect — TPU
 #                  when libtpu is present)
+#
+# Scheduler knobs (docs/scheduler.md has the full table; values are
+# validated at startup — a typo fails fast instead of silently running
+# at a default width):
+#   LO_JOB_WORKERS        host-class concurrency width   (default 8)
+#   LO_SCHED_DEVICE_WIDTH device-class width             (default 1 —
+#                         SPMD dispatches never contend for the mesh)
+#   LO_SCHED_QUEUE_CAP    per-class queue cap; past it submissions get
+#                         HTTP 429 + Retry-After         (default 64)
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 export LO_DATA_DIR="${1:-${LO_DATA_DIR:-$PWD/lo_data}}"
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+# Fail fast on malformed scheduler knobs before bringing up services.
+python - <<'EOF'
+from learningorchestra_tpu.sched import config
+config.host_width(); config.device_width(); config.queue_cap()
+EOF
 
 # SPMD-safety preflight (docs/analysis.md): refuse to serve a build
 # that violates the cross-host invariants — a divergence bug found here

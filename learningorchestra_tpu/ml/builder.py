@@ -31,6 +31,8 @@ from learningorchestra_tpu.core.table import ColumnTable, insert_columns_batched
 from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
+from learningorchestra_tpu.sched import cancel as _cancel
+from learningorchestra_tpu.sched.cancel import check_cancelled
 from learningorchestra_tpu.telemetry import tracing as _tracing
 from learningorchestra_tpu.utils.profiling import PhaseTimer, trace
 
@@ -135,6 +137,12 @@ def train_one(
     }
     timer = PhaseTimer()
 
+    # Cooperative cancellation (DELETE /jobs/<name>): phase boundaries
+    # are the abort points — no-op outside a scheduled job and on SPMD
+    # worker processes (they carry no token; a coordinator-side abort
+    # mid-collective-stream poisons the dispatcher like any mid-job
+    # failure, and the supervisor restarts the runtime).
+    check_cancelled()
     X_train = features_training.feature_matrix(FEATURES_COL)
     y_train = features_training.label_vector(LABEL_COL)
 
@@ -149,6 +157,7 @@ def train_one(
 
         jax.block_until_ready(model.device_state())
     metadata["fit_time"] = timer.timings["fit"]
+    check_cancelled()  # phase boundary: fit done, before checkpoint/eval
 
     # None = "no caller preference" → env fallback; "" = explicitly
     # disabled. The distinction matters on a multi-host mesh: the SPMD
@@ -390,24 +399,29 @@ def _build_model_traced(
     results: list[dict] = []
     # contextvars don't cross pool threads: hand each worker the ambient
     # (trace, span) so its train span — and the PhaseTimer phases inside
-    # — nest under the request/job trace.
+    # — nest under the request/job trace, and the ambient cancel token
+    # so DELETE /jobs/<name> reaches the per-classifier threads.
     context = _tracing.capture()
+    cancel_token = _cancel.current_token()
 
     def run_train(name: str) -> dict:
-        with _tracing.attach(context), _tracing.span(
-            f"train:{name}", classificator=name
-        ):
-            return train_one(
-                store,
-                name,
-                out["features_training"],
-                out["features_testing"],
-                out["features_evaluation"],
-                test_filename,
-                mesh,
-                write_outputs,
-                models_dir,
-            )
+        with _tracing.attach(context), _cancel.bind(cancel_token):
+            # a cancelled build stops launching classifiers: fits
+            # already in flight run to their own next check inside
+            # train_one, queued ones never start
+            check_cancelled()
+            with _tracing.span(f"train:{name}", classificator=name):
+                return train_one(
+                    store,
+                    name,
+                    out["features_training"],
+                    out["features_testing"],
+                    out["features_evaluation"],
+                    test_filename,
+                    mesh,
+                    write_outputs,
+                    models_dir,
+                )
 
     with trace(trace_dir), ThreadPoolExecutor(max_workers=max_workers) as pool:
         futures = [

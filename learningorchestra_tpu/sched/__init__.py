@@ -1,0 +1,76 @@
+"""Durable job scheduler: admission control, priorities, retries,
+deadlines, cancellation, and crash-recovery for every async workload.
+
+The reference's only job abstraction is a ``finished`` boolean a crashed
+worker leaves ``false`` forever (reference database.py:199-216); our
+``JobManager`` fixed the poll-hang but still threw every request
+straight onto an unbounded thread pool. This package is the scheduling
+layer between the REST surface and execution — the substrate systems
+like Ray (Moritz et al., OSDI '18) put at their core:
+
+- :class:`~learningorchestra_tpu.sched.scheduler.Scheduler` — a
+  priority queue per **concurrency class**. Device-bound jobs (model
+  builds, t-SNE/PCA embeddings) serialize at ``LO_SCHED_DEVICE_WIDTH``
+  (default 1) so two SPMD dispatches never contend for the mesh;
+  host-bound jobs (projections, histograms, field-type scans, ingests)
+  run at ``LO_JOB_WORKERS``. Per-class queue caps
+  (``LO_SCHED_QUEUE_CAP``) surface as HTTP 429 + ``Retry-After``.
+- :mod:`~learningorchestra_tpu.sched.policy` — typed transient-failure
+  classification (:class:`TransientJobError`, plus the SPMD watchdog's
+  ``SpmdTimeoutError``) and exponential backoff with deterministic
+  seeded jitter up to a retry budget.
+- :class:`~learningorchestra_tpu.sched.journal.JobJournal` — one
+  document per submit in the :class:`DocumentStore`, state transitions
+  appended, so a restarted service replays the journal
+  (:func:`~learningorchestra_tpu.sched.recovery.recover_jobs`),
+  re-enqueues jobs that never started, and marks orphaned RUNNING jobs
+  FAILED with ``finished: true`` so pollers terminate — the exact crash
+  the reference hangs on.
+- :mod:`~learningorchestra_tpu.sched.cancel` — cooperative cancellation
+  tokens with per-job deadlines, wired to ``DELETE /jobs/<name>`` and
+  checked in the builder's phase loop.
+
+``core/jobs.py`` executes what this package admits; ``docs/scheduler.md``
+is the operator guide.
+"""
+
+from learningorchestra_tpu.sched.cancel import (
+    CancelToken,
+    JobCancelledError,
+    JobTimeoutError,
+    check_cancelled,
+    current_token,
+)
+from learningorchestra_tpu.sched.journal import JOURNAL_COLLECTION, JobJournal
+from learningorchestra_tpu.sched.policy import (
+    TransientJobError,
+    backoff_delay,
+    is_transient,
+)
+from learningorchestra_tpu.sched.recovery import recover_jobs
+from learningorchestra_tpu.sched.scheduler import (
+    DEVICE_CLASS,
+    HOST_CLASS,
+    QueueFullError,
+    Scheduler,
+    Task,
+)
+
+__all__ = [
+    "CancelToken",
+    "DEVICE_CLASS",
+    "HOST_CLASS",
+    "JOURNAL_COLLECTION",
+    "JobCancelledError",
+    "JobJournal",
+    "JobTimeoutError",
+    "QueueFullError",
+    "Scheduler",
+    "Task",
+    "TransientJobError",
+    "backoff_delay",
+    "check_cancelled",
+    "current_token",
+    "is_transient",
+    "recover_jobs",
+]

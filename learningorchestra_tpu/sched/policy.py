@@ -1,0 +1,69 @@
+"""Retry policy: transient-failure classification and seeded backoff.
+
+Transient failures re-enqueue with exponential backoff plus
+deterministic jitter up to a budget; terminal failures keep the
+``finished: true`` + error contract (core/jobs.py). Determinism
+matters twice: the jitter sequence is golden-testable, and a journal
+replay after a crash re-derives the same delays the crashed process
+would have used.
+"""
+
+from __future__ import annotations
+
+import random
+
+from learningorchestra_tpu.sched import config
+
+
+class TransientJobError(RuntimeError):
+    """Raise from job code for failures worth retrying — a store
+    failover window, a flaky download, a briefly-contended device
+    runtime. Anything else (bad input, a bug) is terminal and keeps
+    today's ``finished: true`` + error contract."""
+
+
+# Exception type names (checked by name so this module never imports
+# jax: parallel/spmd.py defines SpmdTimeoutError but importing it pulls
+# the device runtime into every client process) that classify as
+# transient alongside TransientJobError subclasses.
+_TRANSIENT_TYPE_NAMES = frozenset({"SpmdTimeoutError"})
+
+
+def is_transient(error: BaseException) -> bool:
+    """Should this failure re-enqueue (budget permitting)?
+
+    ``TransientJobError`` by contract; ``SpmdTimeoutError`` because the
+    watchdog fires for worker-death *and* for overlong collectives —
+    after the supervisor restarts the runtime the same job usually
+    succeeds, so the retry rides out the restart window. Its subclass
+    check is by type name to keep jax out of the import graph.
+    """
+    if isinstance(error, TransientJobError):
+        return True
+    return any(
+        cls.__name__ in _TRANSIENT_TYPE_NAMES
+        for cls in type(error).__mro__
+    )
+
+
+def backoff_delay(
+    name: str,
+    attempt: int,
+    base_s: float | None = None,
+    cap_s: float | None = None,
+    seed: int | None = None,
+) -> float:
+    """Delay before re-enqueueing ``name``'s attempt ``attempt`` (1 is
+    the first retry): ``min(cap, base * 2**(attempt-1))`` scaled by a
+    deterministic jitter in [0.75, 1.25] derived from (seed, name,
+    attempt) — the same job retries on the same schedule on every
+    process and every replay, while distinct jobs decorrelate instead
+    of thundering back in lockstep."""
+    if attempt < 1:
+        raise ValueError(f"attempt must be >= 1, got {attempt}")
+    base_s = config.backoff_base_s() if base_s is None else base_s
+    cap_s = config.backoff_cap_s() if cap_s is None else cap_s
+    seed = config.jitter_seed() if seed is None else seed
+    raw = min(cap_s, base_s * (2.0 ** (attempt - 1)))
+    jitter = random.Random(f"{seed}:{name}:{attempt}").uniform(0.75, 1.25)
+    return raw * jitter

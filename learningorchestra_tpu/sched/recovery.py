@@ -1,0 +1,155 @@
+"""Crash recovery: replay the journal, re-enqueue or terminate.
+
+The reference's failure mode — the exact one this exists to close — is
+a worker that dies with ``finished: false`` on a dataset's metadata,
+leaving every client polling forever (reference database.py:199-216,
+client __init__.py:24-32). On restart, :func:`recover_jobs`:
+
+1. **Re-enqueues jobs that never started** (last journal event
+   ``submitted``/``retry``) whose operation is in the replay registry —
+   the submit document carries the op name and payload, so the work
+   reconstructs without the original closure (the lineage idea from
+   Ray, reduced to named idempotent operations).
+2. **Marks orphaned RUNNING jobs FAILED** (last event ``started``):
+   appends a terminal ``orphaned`` event and flips the tracked
+   dataset's metadata to ``finished: true`` with an error, so pollers
+   terminate. Never-started jobs with no replay handler get the same
+   terminal treatment — no journal entry is ever left able to hang a
+   client.
+
+Replayable ops are registered by name. ``ingest`` ships built in: it is
+idempotent-by-construction here because only never-STARTED ingests
+replay (a started one may have written partial rows; it is orphaned
+instead). Register more with :func:`register_replay`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID
+from learningorchestra_tpu.sched.journal import JobJournal
+from learningorchestra_tpu.sched.scheduler import QueueFullError
+from learningorchestra_tpu.telemetry import metrics as _metrics
+
+ORPHAN_ERROR = "orphaned by service restart"
+
+# op name -> handler(store, payload). Handlers re-run the work from the
+# journaled payload alone.
+_REPLAY_REGISTRY: dict[str, Callable] = {}
+
+
+def register_replay(op: str, handler: Callable) -> None:
+    _REPLAY_REGISTRY[op] = handler
+
+
+def _replay_ingest(store, payload: dict) -> None:
+    from learningorchestra_tpu.core.ingest import ingest_csv
+
+    ingest_csv(store, payload["filename"], payload["url"])
+
+
+register_replay("ingest", _replay_ingest)
+
+
+def _recovered_counter():
+    return _metrics.global_registry().counter(
+        "lo_sched_recovered_total",
+        "Journal-replay outcomes at service restart",
+        labels=("outcome",),
+    )
+
+
+def _terminate_poller(store, collection: str, error: str) -> None:
+    """Flip the tracked dataset's metadata so clients polling
+    ``finished`` stop — the crash the reference hangs on."""
+    try:
+        store.update_one(
+            collection,
+            {ROW_ID: METADATA_ID},
+            {"finished": True, "error": error},
+        )
+    except Exception:  # noqa: BLE001 — collection may be gone
+        pass
+
+
+def recover_jobs(store, jobs, journal: JobJournal | None = None) -> dict:
+    """Replay ``journal`` (default: ``jobs``'s own, else a fresh
+    scope-"all" one over ``store``) and reconcile every non-terminal
+    entry. Returns ``{"requeued": [names], "orphaned": [names]}``.
+
+    Call once at process start, before the REST surface accepts
+    traffic and after the store has replayed its WAL. ``jobs`` is the
+    process's JobManager: requeued work becomes ordinary tracked jobs
+    (records, traces, fresh journal entries).
+    """
+    journal = journal or getattr(jobs, "journal", None) or JobJournal(store)
+    histories = journal.replay()
+    counter = _recovered_counter()
+    requeued: list[str] = []
+    orphaned: list[str] = []
+    live = [h for h in histories.values() if not h.terminal]
+    if not live:
+        # Nothing to reconcile. If replay also proved the journal holds
+        # no other scope's events, the whole collection is dead weight:
+        # drop it — this clean-restart compaction is what bounds
+        # journal growth across restart cycles.
+        if not journal.saw_foreign_scope:
+            journal.compact()
+        return {"requeued": requeued, "orphaned": orphaned}
+    # Live histories exist: recovery stays strictly APPEND-ONLY.
+    # Compacting first would open a window where a crash between the
+    # drop and the re-submits loses every pending job — the exact
+    # hung-poller bug this subsystem exists to close. The extra
+    # documents cost a little store space until the next clean restart
+    # compacts them.
+
+    def orphan(name: str, collection, outcome: str) -> None:
+        """Terminate one unrecoverable history: journal the terminal
+        event and flip the tracked dataset so pollers stop."""
+        orphaned.append(name)
+        counter.labels(outcome).inc()
+        journal.append(name, "orphaned", error=ORPHAN_ERROR)
+        if collection:
+            _terminate_poller(store, collection, ORPHAN_ERROR)
+
+    for name, history in histories.items():
+        if history.terminal:
+            continue
+        submit = history.submit
+        collection = submit.get("collection")
+        if history.started:
+            # Orphaned RUNNING job: the process died mid-flight. It may
+            # have half-written output, so it never replays — it fails,
+            # visibly, and its pollers terminate.
+            orphan(name, collection, "orphaned")
+            continue
+        handler = _REPLAY_REGISTRY.get(submit.get("op"))
+        if handler is None:
+            # Admitted but never started, and not replayable: terminal,
+            # for the same no-hung-pollers reason.
+            orphan(name, collection, "unreplayable")
+            continue
+        payload = submit.get("payload") or {}
+        try:
+            jobs.submit(
+                name,
+                handler,
+                store,
+                payload,
+                store=store if collection else None,
+                collection=collection,
+                job_class=submit.get("job_class") or "host",
+                priority=int(submit.get("priority") or 0),
+                replay=(submit["op"], payload),
+            )
+        except QueueFullError:
+            # a backlog larger than the queue cap must not crash the
+            # restart: past the cap, the remainder terminates like
+            # unreplayable work (clients resubmit) instead of wedging
+            # bring-up
+            orphan(name, collection, "dropped")
+            continue
+        requeued.append(name)
+        counter.labels("requeued").inc()
+    return {"requeued": requeued, "orphaned": orphaned}

@@ -19,9 +19,13 @@ subset against a shared store.
 
 Beyond the reference surface, every service answers ``GET /metrics``
 (Prometheus text exposition — request counts/latency, job states,
-jitcache hit/miss, store occupancy; see docs/observability.md), and the
-job-bearing services (database_api, model_builder) answer
-``GET /jobs/<name>/trace`` with the job's correlated span tree.
+jitcache hit/miss, store occupancy; see docs/observability.md) and the
+job surface (``GET /jobs``, ``GET /jobs/<name>/trace``,
+``DELETE /jobs/<name>`` for cooperative cancellation): since the
+scheduler subsystem (docs/scheduler.md) every service's work runs as a
+tracked job through class-aware priority queues — device-bound jobs
+serialize so SPMD dispatches never contend for the mesh, and a full
+queue answers 429 + ``Retry-After``.
 """
 
 DATABASE_API_PORT = 5000

@@ -25,8 +25,9 @@ from learningorchestra_tpu.core.store import (
     UnsupportedQueryError,
     parse_query,
 )
+from learningorchestra_tpu.sched import HOST_CLASS, QueueFullError
 from learningorchestra_tpu.telemetry import register_store
-from learningorchestra_tpu.utils.web import WebApp
+from learningorchestra_tpu.utils.web import WebApp, too_many_requests
 
 MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "file_created"
@@ -38,8 +39,11 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     app = WebApp("database_api")
     jobs = jobs or JobManager()
     register_store(store)
-    # GET /jobs/<name>/trace — the ingest job's correlated span tree
-    app.register_job_traces(jobs)
+    # GET /jobs (+ /trace, DELETE): every async job's state — PENDING/
+    # RUNNING/FINISHED/FAILED/CANCELLED, class, attempts, timings,
+    # error — inspectable and cancellable over REST instead of only via
+    # each collection's metadata row.
+    app.register_job_routes(jobs)
 
     @app.route("/files", methods=("POST",))
     def create_file(request):
@@ -54,23 +58,27 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
             write_ingest_metadata(store, filename, url)
         except KeyError:
             return {MESSAGE_RESULT: DUPLICATE_FILE}, 409
-        jobs.submit(
-            f"ingest:{filename}",
-            ingest_csv,
-            store,
-            filename,
-            url,
-            store=store,
-            collection=filename,
-        )
+        try:
+            jobs.submit(
+                f"ingest:{filename}",
+                ingest_csv,
+                store,
+                filename,
+                url,
+                store=store,
+                collection=filename,
+                job_class=HOST_CLASS,
+                # the journaled lineage: a restart that finds this job
+                # admitted-but-never-started re-runs the ingest from
+                # (filename, url) alone (sched/recovery.py)
+                replay=("ingest", {"filename": filename, "url": url}),
+            )
+        except QueueFullError as error:
+            # admission refused: undo the name claim so the client can
+            # simply resubmit after Retry-After
+            store.drop(filename)
+            return too_many_requests(error)
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
-
-    @app.route("/jobs", methods=("GET",))
-    def read_jobs(request):
-        # Observability beyond the reference: every async job's state
-        # (PENDING/RUNNING/FINISHED/FAILED, timings, error) inspectable
-        # over REST instead of only via each collection's metadata row.
-        return {MESSAGE_RESULT: jobs.all_jobs()}, 200
 
     @app.route("/files/<filename>", methods=("GET",))
     def read_file(request, filename):

@@ -7,19 +7,23 @@ histogram.py:25)."""
 
 from __future__ import annotations
 
+from learningorchestra_tpu.core.jobs import JobManager
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ops.histogram import create_histogram
+from learningorchestra_tpu.sched import HOST_CLASS, QueueFullError
 from learningorchestra_tpu.services import validators
 from learningorchestra_tpu.telemetry import register_store, span
-from learningorchestra_tpu.utils.web import WebApp
+from learningorchestra_tpu.utils.web import WebApp, too_many_requests
 
 MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
 
 
-def create_app(store: DocumentStore) -> WebApp:
+def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     app = WebApp("histogram")
+    jobs = jobs or JobManager()
     register_store(store)
+    app.register_job_routes(jobs)
 
     @app.route("/histograms/<parent_filename>", methods=("POST",))
     def create_histogram_route(request, parent_filename):
@@ -40,11 +44,22 @@ def create_app(store: DocumentStore) -> WebApp:
         # Atomic claim closes the duplicate-create race (SURVEY §5).
         if not store.create_collection(histogram_filename):
             return {MESSAGE_RESULT: validators.MESSAGE_HISTOGRAM_DUPLICATE}, 409
-        try:
+
+        def work() -> None:
             with span("histogram:compute", parent=parent_filename):
                 create_histogram(
                     store, parent_filename, histogram_filename, list(fields)
                 )
+
+        # Synchronous response, scheduled execution: host-class width
+        # bounds concurrent aggregations, the queue cap backpressures.
+        try:
+            jobs.run_sync(
+                f"histogram:{histogram_filename}", work, job_class=HOST_CLASS
+            )
+        except QueueFullError as error:
+            store.drop(histogram_filename)  # release the name claim
+            return too_many_requests(error)
         except BaseException:
             store.drop(histogram_filename)
             raise

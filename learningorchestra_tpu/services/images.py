@@ -11,11 +11,13 @@ from __future__ import annotations
 import contextlib
 import os
 
+from learningorchestra_tpu.core.jobs import JobManager
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ops.images import IMAGE_FORMAT, create_embedding_image
+from learningorchestra_tpu.sched import DEVICE_CLASS, QueueFullError
 from learningorchestra_tpu.services import validators
 from learningorchestra_tpu.telemetry import register_store, span
-from learningorchestra_tpu.utils.web import WebApp, send_file
+from learningorchestra_tpu.utils.web import WebApp, send_file, too_many_requests
 
 MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
@@ -30,16 +32,25 @@ CLAIM_SUFFIX = ".part"
 
 
 def create_app(
-    store: DocumentStore, images_path: str, method: str, create=None
+    store: DocumentStore,
+    images_path: str,
+    method: str,
+    create=None,
+    jobs: JobManager | None = None,
 ) -> WebApp:
     """``method`` is "tsne" or "pca"; the request filename key follows it.
 
     ``create`` overrides how a validated request becomes a
     create_embedding_image call — the multi-host runner injects an SPMD
     dispatch (parallel/spmd.py) so every process enters the embedding;
-    default is the in-process call."""
+    default is the in-process call. Embeddings are device-bound (the
+    t-SNE/PCA solvers own the mesh while they iterate), so creates run
+    through the scheduler's DEVICE class and serialize against model
+    builds instead of contending with them."""
     app = WebApp(method)
+    jobs = jobs or JobManager()
     register_store(store)
+    app.register_job_routes(jobs)
 
     if create is None:
 
@@ -112,9 +123,17 @@ def create_app(
             # marker + absent PNG is. Never overwrite a finished image.
             release_claim(output_filename, keep_png=True)
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
-        try:
+        def work() -> None:
             with span(f"{method}:embed", parent=parent_filename):
                 create(parent_filename, label_name, output_filename)
+
+        try:
+            jobs.run_sync(
+                f"{method}:{output_filename}", work, job_class=DEVICE_CLASS
+            )
+        except QueueFullError as error:
+            release_claim(output_filename, keep_png=False)
+            return too_many_requests(error)
         except BaseException:
             release_claim(output_filename, keep_png=False)
             raise

@@ -28,9 +28,10 @@ from learningorchestra_tpu.ml.checkpoint import (
     CHECKPOINT_SUFFIX,
     checkpoint_path as _checkpoint_path,
 )
+from learningorchestra_tpu.sched import DEVICE_CLASS, QueueFullError
 from learningorchestra_tpu.services import validators
 from learningorchestra_tpu.telemetry import register_store
-from learningorchestra_tpu.utils.web import WebApp
+from learningorchestra_tpu.utils.web import WebApp, too_many_requests
 
 MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
@@ -57,16 +58,25 @@ def create_app(
     multi-minute build no longer pins a WSGI worker invisibly;
     ``GET /jobs`` on this service reports its state
     (PENDING/RUNNING/FINISHED/FAILED + error payload)."""
+    import itertools
+
     from learningorchestra_tpu.core.jobs import DuplicateJobError, JobManager
 
     app = WebApp("model_builder")
+    # Reference parity allows a concurrent SAME-NAME sync build/predict
+    # to run too (racy allow-both, reference server.py:112-115). The
+    # duplicate still goes through the device queue — just under a
+    # uniquified job name — so "two SPMD dispatches never contend for
+    # the mesh" holds even for the parity path.
+    duplicate_seq = itertools.count(1)
     models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
     jobs = jobs or JobManager()
     register_store(store)
-    # GET /jobs/<name>/trace — a build's span tree: per-classifier train
-    # spans, each nesting the PhaseTimer fit/evaluate/predict/write
-    # phases, all under the request's correlation ID
-    app.register_job_traces(jobs)
+    # GET /jobs (+ /trace, DELETE): a build's state and span tree —
+    # per-classifier train spans nesting the PhaseTimer fit/evaluate/
+    # predict/write phases under the request's correlation ID — plus
+    # cooperative cancellation of queued/running builds.
+    app.register_job_routes(jobs)
 
     def checkpoint_path(name: str) -> str:
         return _checkpoint_path(models_dir, name)
@@ -127,7 +137,9 @@ def create_app(
         )
         if body.get("async"):
             try:
-                jobs.submit(job_name, build, body)
+                jobs.submit(job_name, build, body, job_class=DEVICE_CLASS)
+            except QueueFullError as error:  # device queue at its cap
+                return too_many_requests(error)
             except ValueError as error:  # same job already active
                 return {MESSAGE_RESULT: str(error)}, 409
             return {
@@ -135,25 +147,37 @@ def create_app(
                 "job": job_name,
             }, 201
         # Synchronous stays the reference contract (201 after ALL fits)
-        # but runs as a TRACKED inline job, so the build still gets a
-        # correlated span tree at /jobs/<name>/trace. A concurrent
-        # same-name sync build falls back to untracked execution rather
-        # than changing the reference's (racy) allow-both behaviour.
+        # but runs as a TRACKED job through the scheduler's DEVICE
+        # class, so concurrent builds queue for the mesh instead of
+        # contending on it (the request thread blocks; a scheduler
+        # worker executes) and the build still gets a correlated span
+        # tree at /jobs/<name>/trace. A concurrent same-name sync build
+        # falls back to untracked execution rather than changing the
+        # reference's (racy) allow-both behaviour.
         try:
-            jobs.run_inline(job_name, build, body)
+            jobs.run_sync(job_name, build, body, job_class=DEVICE_CLASS)
+        except QueueFullError as error:
+            return too_many_requests(error)
         except DuplicateJobError:  # already active: reference parity.
-            # NOT a bare ValueError — run_inline re-raises the build's
+            # NOT a bare ValueError — run_sync re-raises the build's
             # OWN exceptions, and a build that failed with ValueError
-            # must surface, not silently run a second time.
-            build(body)
+            # must surface, not silently run a second time. The rerun
+            # keeps the allow-both behaviour but STAYS on the device
+            # queue (unique name) so it cannot overlap the first on
+            # the mesh.
+            try:
+                jobs.run_sync(
+                    f"{job_name}#dup{next(duplicate_seq)}",
+                    build,
+                    body,
+                    job_class=DEVICE_CLASS,
+                )
+            except QueueFullError as error:
+                return too_many_requests(error)
         # response body stays the verbatim reference payload (clients
         # and the golden tests compare it whole); the job name is
         # derivable and /jobs lists it
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
-
-    @app.route("/jobs", methods=("GET",))
-    def read_jobs(request):
-        return {MESSAGE_RESULT: jobs.all_jobs()}, 200
 
     @app.route("/models", methods=("GET",))
     def list_models(request):
@@ -221,7 +245,32 @@ def create_app(
             validators.filename_free(store, body["prediction_filename"])
         except validators.ValidationError as error:
             return {MESSAGE_RESULT: error.args[0]}, 409
-        predict(model_name, body)
+        # checkpoint predictions run a forward pass on the mesh: same
+        # device-class queue as builds, so they never overlap an SPMD fit
+        try:
+            jobs.run_sync(
+                f"predict:{body['prediction_filename']}",
+                predict,
+                model_name,
+                body,
+                job_class=DEVICE_CLASS,
+            )
+        except QueueFullError as error:
+            return too_many_requests(error)
+        except DuplicateJobError:
+            # same parity rule as builds: the concurrent duplicate runs,
+            # but through the device queue, never inline on the mesh
+            try:
+                jobs.run_sync(
+                    f"predict:{body['prediction_filename']}#dup"
+                    f"{next(duplicate_seq)}",
+                    predict,
+                    model_name,
+                    body,
+                    job_class=DEVICE_CLASS,
+                )
+            except QueueFullError as error:
+                return too_many_requests(error)
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
 
     return app

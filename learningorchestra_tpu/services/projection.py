@@ -7,19 +7,23 @@ requested fields before submitting (server.py:104-106)."""
 
 from __future__ import annotations
 
+from learningorchestra_tpu.core.jobs import JobManager
 from learningorchestra_tpu.core.store import DocumentStore
 from learningorchestra_tpu.ops.projection import project
+from learningorchestra_tpu.sched import HOST_CLASS, QueueFullError
 from learningorchestra_tpu.services import validators
 from learningorchestra_tpu.telemetry import register_store, span
-from learningorchestra_tpu.utils.web import WebApp
+from learningorchestra_tpu.utils.web import WebApp, too_many_requests
 
 MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
 
 
-def create_app(store: DocumentStore) -> WebApp:
+def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     app = WebApp("projection")
+    jobs = jobs or JobManager()
     register_store(store)
+    app.register_job_routes(jobs)
 
     @app.route("/projections/<parent_filename>", methods=("POST",))
     def create_projection(request, parent_filename):
@@ -39,11 +43,23 @@ def create_app(store: DocumentStore) -> WebApp:
         # the loser a 409 (the check-then-act race SURVEY §5 flags).
         if not store.create_collection(projection_filename):
             return {MESSAGE_RESULT: validators.MESSAGE_DUPLICATE_FILE}, 409
-        try:
+
+        def work() -> None:
             with span("projection:project", parent=parent_filename):
                 project(
                     store, parent_filename, projection_filename, list(fields)
                 )
+
+        # The response stays synchronous (reference parity) but the
+        # work runs through the scheduler's host class: bounded
+        # concurrency under load, 429 + Retry-After past the queue cap.
+        try:
+            jobs.run_sync(
+                f"projection:{projection_filename}", work, job_class=HOST_CLASS
+            )
+        except QueueFullError as error:
+            store.drop(projection_filename)  # release the name claim
+            return too_many_requests(error)
         except BaseException:
             store.drop(projection_filename)
             raise

@@ -31,6 +31,15 @@ Environment:
   serves REST and broadcasts compute jobs, the rest run SPMD worker
   loops (parallel/spmd.py). Requires ``LO_STORE_URL`` and a shared
   ``LO_MODELS_DIR``. One jax process per host.
+- ``LO_JOB_WORKERS`` / ``LO_SCHED_DEVICE_WIDTH`` / ``LO_SCHED_QUEUE_CAP``
+  — scheduler knobs (sched/config.py has the full table): host-class
+  concurrency width (default 8, replacing the old hardcoded pool),
+  device-class width (default 1 — SPMD dispatches never contend for the
+  mesh), and the per-class queue cap past which submissions get HTTP
+  429 + ``Retry-After``. All seven services submit through ONE
+  process-wide scheduler whose journal (in the store) lets a restarted
+  process re-enqueue never-started jobs and terminate pollers of
+  orphaned ones — docs/scheduler.md.
 - ``LO_HOST`` — bind host. Defaults to ``127.0.0.1``: the model-builder
   service executes request-supplied preprocessor code (reference parity),
   so exposing the stack beyond localhost must be an explicit opt-in
@@ -73,6 +82,7 @@ from typing import Optional
 
 from learningorchestra_tpu.core.jobs import JobManager
 from learningorchestra_tpu.core.store import DocumentStore, InMemoryStore
+from learningorchestra_tpu.sched import JobJournal, Scheduler, recover_jobs
 from learningorchestra_tpu.services import (
     DATA_TYPE_HANDLER_PORT,
     DATABASE_API_PORT,
@@ -161,17 +171,28 @@ def make_dispatcher(store: DocumentStore, images_dir: str):
     return dispatcher
 
 
+def make_job_manager(store: DocumentStore, scope: str = "all") -> JobManager:
+    """One JobManager for the whole process: every service submits
+    through a single scheduler, so the DEVICE class serializes builds
+    and embeddings against each other process-wide, and every submit is
+    journaled in the shared store for crash recovery."""
+    return JobManager(
+        scheduler=Scheduler(journal=JobJournal(store, scope=scope))
+    )
+
+
 def build_app(
     name: str,
     store: DocumentStore,
     images_dir: str,
     dispatcher=None,
     models_dir: str = "",
+    jobs: "JobManager | None" = None,
 ):
     if name == "database_api":
-        return database_api.create_app(store, JobManager())
+        return database_api.create_app(store, jobs or JobManager())
     if name == "projection":
-        return projection.create_app(store)
+        return projection.create_app(store, jobs)
     if name == "model_builder":
         # Opt-in (LO_MODELS_DIR / models_dir): library and test callers
         # of start_all don't silently grow a checkpoint directory.
@@ -204,12 +225,13 @@ def build_app(
                     },
                 )
         return model_builder.create_app(
-            store, build=build, models_dir=models_dir, predict=predict
+            store, build=build, models_dir=models_dir, predict=predict,
+            jobs=jobs,
         )
     if name == "data_type_handler":
-        return data_type_handler.create_app(store)
+        return data_type_handler.create_app(store, jobs)
     if name == "histogram":
-        return histogram.create_app(store)
+        return histogram.create_app(store, jobs)
     if name in ("tsne", "pca"):
         create = None
         if dispatcher is not None:
@@ -224,16 +246,25 @@ def build_app(
                     },
                 )
         return images.create_app(
-            store, os.path.join(images_dir, name), name, create=create
+            store, os.path.join(images_dir, name), name, create=create,
+            jobs=jobs,
         )
     raise KeyError(f"unknown service {name!r}")
 
 
 def build_apps(
-    store: DocumentStore, images_dir: str, dispatcher=None, models_dir: str = ""
+    store: DocumentStore,
+    images_dir: str,
+    dispatcher=None,
+    models_dir: str = "",
+    jobs: "JobManager | None" = None,
 ) -> dict[int, object]:
+    # One shared JobManager unless the caller brings their own: the
+    # seven services must share a scheduler or the device class cannot
+    # serialize builds against embeddings.
+    jobs = jobs or make_job_manager(store)
     return {
-        port: build_app(name, store, images_dir, dispatcher, models_dir)
+        port: build_app(name, store, images_dir, dispatcher, models_dir, jobs)
         for name, port in SERVICES.items()
     }
 
@@ -245,6 +276,7 @@ def start_all(
     ephemeral: bool = False,
     dispatcher=None,
     models_dir: str = "",
+    jobs: "JobManager | None" = None,
 ) -> tuple[DocumentStore, list[ServerThread]]:
     """Start all seven services on their reference ports; returns the
     shared store and the server threads (callers stop() them).
@@ -256,7 +288,8 @@ def start_all(
     store = store if store is not None else InMemoryStore()
     images_dir = images_dir or os.path.join(os.getcwd(), "lo_images")
     servers = []
-    for port, app in build_apps(store, images_dir, dispatcher, models_dir).items():
+    apps = build_apps(store, images_dir, dispatcher, models_dir, jobs)
+    for port, app in apps.items():
         server = ServerThread(app, host, 0 if ephemeral else port)
         server.canonical_port = port
         servers.append(server.start())
@@ -354,10 +387,27 @@ def main() -> None:
             dispatcher.run_worker_loop()
             return
 
+    # One scheduler + journal for every service this process runs.
+    # Scope the journal to the service in the one-process-per-service
+    # topology so each restarted process recovers only its own jobs
+    # from the shared store. Recovery runs BEFORE the REST surface
+    # accepts traffic: never-started jobs re-enqueue, orphaned RUNNING
+    # jobs go FAILED with finished:true so pollers terminate — the
+    # crash the reference hangs on (docs/scheduler.md).
+    jobs = make_job_manager(store, scope=service or "all")
+    recovered = recover_jobs(store, jobs)
+    if recovered["requeued"] or recovered["orphaned"]:
+        print(
+            "job recovery: "
+            f"{len(recovered['requeued'])} re-enqueued, "
+            f"{len(recovered['orphaned'])} orphaned jobs marked failed",
+            flush=True,
+        )
+
     if service:
         port = int(os.environ.get("LO_PORT", SERVICES[service]))
         server = ServerThread(
-            build_app(service, store, images_dir, dispatcher, models_dir),
+            build_app(service, store, images_dir, dispatcher, models_dir, jobs),
             host,
             port,
         )
@@ -372,6 +422,7 @@ def main() -> None:
             ephemeral=os.environ.get("LO_EPHEMERAL") == "1",
             dispatcher=dispatcher,
             models_dir=models_dir,
+            jobs=jobs,
         )
         port_names = {port: name for name, port in SERVICES.items()}
         for server in servers:

@@ -31,6 +31,26 @@ def jsonify(payload: Any) -> Response:
     )
 
 
+def too_many_requests(error) -> Response:
+    """HTTP 429 for a :class:`~learningorchestra_tpu.sched.scheduler.
+    QueueFullError`: admission control's REST face. ``Retry-After``
+    carries the scheduler's backlog-drain estimate so well-behaved
+    clients pace themselves instead of hammering a full queue."""
+    response = Response(
+        json.dumps(
+            {
+                "result": "queue_full",
+                "job_class": error.job_class,
+                "retry_after_s": error.retry_after_s,
+            }
+        ),
+        mimetype="application/json",
+        status=429,
+    )
+    response.headers["Retry-After"] = str(error.retry_after_s)
+    return response
+
+
 def send_file(path: str, mimetype: str) -> Response:
     with open(path, "rb") as handle:
         data = handle.read()
@@ -89,6 +109,32 @@ class WebApp:
             if record is None:
                 return {"result": "not_found"}, 404
             return {"result": record.trace_dict()}, 200
+
+    def register_job_routes(self, jobs) -> None:
+        """The full job surface for a service holding a JobManager:
+
+        - ``GET /jobs`` — every tracked job's state, class, priority,
+          attempt count, timings, error, and correlation ID;
+        - ``GET /jobs/<name>/trace`` — its correlated span tree;
+        - ``DELETE /jobs/<name>`` — cooperative cancellation: a queued
+          job terminates without running, a running one at its next
+          cancel check (ml/builder.py's phase loop checks); 202 while
+          the cancel propagates, 409 once the job is already terminal.
+        """
+        self.register_job_traces(jobs)
+
+        @self.route("/jobs")
+        def read_jobs(request):
+            return {"result": jobs.all_jobs()}, 200
+
+        @self.route("/jobs/<job_name>", methods=("DELETE",))
+        def cancel_job(request, job_name):
+            outcome = jobs.cancel(job_name)
+            if outcome == "unknown":
+                return {"result": "not_found"}, 404
+            if outcome == "terminal":
+                return {"result": "already_terminal"}, 409
+            return {"result": "cancelling"}, 202
 
     def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
         def decorator(handler: Callable) -> Callable:

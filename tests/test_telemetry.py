@@ -273,7 +273,7 @@ class TestBuildTrace:
     def test_failing_sync_build_runs_once_and_surfaces_error(
         self, titanic_store
     ):
-        # run_inline re-raises the build's own ValueError; the handler
+        # run_sync re-raises the build's own ValueError; the handler
         # must not mistake it for "job already active" and rerun the
         # build (the double-execution would duplicate partial writes)
         calls = []
