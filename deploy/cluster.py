@@ -46,6 +46,11 @@ Manifest (JSON)::
         "device_width": 1,         #   LO_SCHED_DEVICE_WIDTH
         "queue_cap": 64            #   LO_SCHED_QUEUE_CAP (429 past it)
       },
+      "dataplane": {               # optional data-plane knobs, validated
+        "devcache_bytes": 2000000000,  # LO_DEVCACHE_BYTES (0 disables)
+        "store_compress": 0,       #   LO_STORE_COMPRESS (1 = zlib wire)
+        "write_overlap": 1         #   LO_WRITE_OVERLAP (0 = sync writes)
+      },
       "restart_delay": 5,
       "max_cluster_restarts": null # null = retry forever
     }
@@ -111,6 +116,22 @@ def load_manifest(path: str) -> dict:
             or sched[key] < 1
         ):
             raise SystemExit(f"sched.{key} must be a positive integer")
+    dataplane = manifest.setdefault("dataplane", {})
+    for key in dataplane:
+        if key not in _DATAPLANE_KNOBS:
+            raise SystemExit(
+                f"unknown dataplane knob {key!r} (have: "
+                f"{', '.join(sorted(_DATAPLANE_KNOBS))})"
+            )
+        value = dataplane[key]
+        # same bool-is-int trap as the sched knobs
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise SystemExit(f"dataplane.{key} must be an integer")
+        if key == "devcache_bytes":
+            if value < 0:
+                raise SystemExit("dataplane.devcache_bytes must be >= 0")
+        elif value not in (0, 1):
+            raise SystemExit(f"dataplane.{key} must be 0 or 1")
     return manifest
 
 
@@ -119,6 +140,15 @@ _SCHED_KNOBS = {
     "job_workers": "LO_JOB_WORKERS",
     "device_width": "LO_SCHED_DEVICE_WIDTH",
     "queue_cap": "LO_SCHED_QUEUE_CAP",
+}
+
+# manifest dataplane.<knob> -> the env var every machine receives
+# (docs/dataplane.md). Cluster-wide like the sched knobs: a device
+# cache sized differently per host would skew per-host HBM headroom.
+_DATAPLANE_KNOBS = {
+    "devcache_bytes": "LO_DEVCACHE_BYTES",
+    "store_compress": "LO_STORE_COMPRESS",
+    "write_overlap": "LO_WRITE_OVERLAP",
 }
 
 
@@ -144,6 +174,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _SCHED_KNOBS.items():
         if knob in manifest.get("sched", {}):
             shared[env_var] = str(manifest["sched"][knob])
+    for knob, env_var in _DATAPLANE_KNOBS.items():
+        if knob in manifest.get("dataplane", {}):
+            shared[env_var] = str(manifest["dataplane"][knob])
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 

@@ -23,16 +23,32 @@
 #                         SPMD dispatches never contend for the mesh)
 #   LO_SCHED_QUEUE_CAP    per-class queue cap; past it submissions get
 #                         HTTP 429 + Retry-After         (default 64)
+#
+# Data-plane knobs (docs/dataplane.md has the full table):
+#   LO_DEVCACHE_BYTES     rev-keyed device-cache capacity in bytes
+#                         (default 2e9; 0 disables)
+#   LO_STORE_COMPRESS     1 = zlib the binary store wire (worth it on
+#                         narrow links; default 0)
+#   LO_WRITE_OVERLAP      0 = synchronous prediction write-back
+#                         (default 1: writes overlap the next fit)
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 export LO_DATA_DIR="${1:-${LO_DATA_DIR:-$PWD/lo_data}}"
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-# Fail fast on malformed scheduler knobs before bringing up services.
+# Fail fast on malformed scheduler/data-plane knobs before bringing up
+# services.
 python - <<'EOF'
+import os
 from learningorchestra_tpu.sched import config
 config.host_width(); config.device_width(); config.queue_cap()
+from learningorchestra_tpu.core import devcache
+devcache.capacity_bytes()
+for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP"):
+    value = os.environ.get(knob, "").strip()
+    if value and value not in ("0", "1"):
+        raise SystemExit(f"{knob} must be 0 or 1, got {value!r}")
 EOF
 
 # SPMD-safety preflight (docs/analysis.md): refuse to serve a build

@@ -388,6 +388,13 @@ class DocumentStore:
             ).items()
         }
 
+    def collection_rev(self, collection: str) -> int:
+        """Mutation counter for torn-read detection and device-cache
+        invalidation (core/devcache.py). -1 = unknown/missing: backends
+        that cannot report one opt every cached reader out, never into
+        staleness."""
+        return -1
+
     # --- dataset metadata contract -------------------------------------------
     def metadata(self, collection: str) -> Optional[dict]:
         return self.find_one(collection, {ROW_ID: METADATA_ID})
@@ -454,7 +461,11 @@ class _Collection:
         self.block_start = 1
         self.rows: dict[Any, dict] = {}
         # Mutation counter: paged wire readers compare it across chunks
-        # to detect (and retry) a torn multi-request read.
+        # to detect (and retry) a torn multi-request read, and the
+        # device cache keys entries by it. Values are drawn from the
+        # STORE's monotonic sequence (never per-collection counting) so
+        # a dropped-and-recreated collection can't reissue a rev a cache
+        # somewhere still holds.
         self.rev = 0
 
     def snapshot(self) -> "_Collection":
@@ -590,6 +601,16 @@ class InMemoryStore(DocumentStore):
     def __init__(self, data_dir: Optional[str] = None, replicate: bool = False):
         self._lock = threading.RLock()
         self._collections: dict[str, _Collection] = {}
+        # Store-wide rev sequence (see _Collection.rev), started at a
+        # random per-boot base: revs are in-memory only, so a restarted
+        # store would otherwise count from 1 again and could reissue a
+        # rev that a client's device cache (core/devcache.py) still
+        # holds for DIFFERENT pre-restart content. 48 random bits keep
+        # collisions negligible while staying far under 2^53 (revs ride
+        # JSON frames).
+        import secrets
+
+        self._rev_seq = itertools.count(secrets.randbits(48) + 1)
         self._wal = None
         # Replication: when enabled, every WAL record (as its serialized
         # JSON line) is also kept in an in-memory buffer so followers can
@@ -979,7 +1000,7 @@ class InMemoryStore(DocumentStore):
         if col.has_id(doc_id):
             raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
         col.rows[doc_id] = dict(document)
-        col.rev += 1
+        col.rev = next(self._rev_seq)
 
     def _apply_insert_columns(
         self,
@@ -989,7 +1010,7 @@ class InMemoryStore(DocumentStore):
     ) -> None:
         col = self._collections.setdefault(collection, _Collection())
         col.append_columns(columns, start_id)
-        col.rev += 1
+        col.rev = next(self._rev_seq)
         try:
             self._maybe_spill()
         except OSError as error:
@@ -1092,7 +1113,7 @@ class InMemoryStore(DocumentStore):
         col = self._collections.get(collection)
         if col is None:
             return
-        col.rev += 1
+        col.rev = next(self._rev_seq)
         if list(query.keys()) == [ROW_ID] and (
             _is_int_id(query[ROW_ID]) or isinstance(query[ROW_ID], str)
         ):  # the dominant fast path: literal-id lookup
@@ -1116,7 +1137,7 @@ class InMemoryStore(DocumentStore):
         col = self._collections.get(collection)
         if col is None:
             return
-        col.rev += 1
+        col.rev = next(self._rev_seq)
         ensured = False
         for doc_id, value in values_by_id.items():
             if col.in_block(doc_id):
@@ -1136,7 +1157,7 @@ class InMemoryStore(DocumentStore):
         col = self._collections.get(collection)
         if col is None:
             return
-        col.rev += 1
+        col.rev = next(self._rev_seq)
         # Whole-block replace: one column swap, no per-id work.
         if (
             col.block_columns

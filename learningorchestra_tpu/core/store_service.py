@@ -97,7 +97,13 @@ from learningorchestra_tpu.core.store import (
     UnsupportedQueryError,
 )
 from learningorchestra_tpu.core.wire import (
+    ACCEPT_HEADER,
+    COMPRESS_MIN_BYTES,
     CONTENT_TYPE as BIN_CONTENT_TYPE,
+    ENCODING_HEADER,
+    WIRE_COMPRESSION,
+    compress_frame,
+    decode_body,
     decode_frame,
     encode_frame,
 )
@@ -285,6 +291,21 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
         )
         return {"columns": columns}, 200
 
+    def frame_body(request) -> bytes:
+        """The request's frame bytes, wire compression undone (a client
+        stamps ENCODING_HEADER on compressed uploads)."""
+        return decode_body(
+            request.get_data(), request.headers.get(ENCODING_HEADER)
+        )
+
+    @app.route("/c/<name>/rev", methods=("GET",))
+    def collection_rev(request, name):
+        """The collection's mutation counter — what remote device caches
+        probe to validate an entry (core/devcache.py). Same counter the
+        binary read frames carry per chunk. Every DocumentStore has the
+        method (the base class answers -1 = unknown)."""
+        return {"rev": store.collection_rev(name)}, 200
+
     @app.route("/c/<name>/read_columns_bin", methods=("POST",))
     @guarded
     def read_columns_bin(request, name):
@@ -307,13 +328,22 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
             )
             rev = -1
         frame = encode_frame(columns, extra={"rev": rev})
-        return Response(frame, mimetype=BIN_CONTENT_TYPE, status=200)
+        headers = {}
+        if (
+            WIRE_COMPRESSION in request.headers.get(ACCEPT_HEADER, "")
+            and len(frame) >= COMPRESS_MIN_BYTES
+        ):
+            frame = compress_frame(frame)
+            headers[ENCODING_HEADER] = WIRE_COMPRESSION
+        return Response(
+            frame, mimetype=BIN_CONTENT_TYPE, status=200, headers=headers
+        )
 
     @app.route("/c/<name>/insert_columns_bin", methods=("POST",))
     @guarded
     @mutating
     def insert_columns_bin(request, name):
-        columns, extra = decode_frame(request.get_data())
+        columns, extra = decode_frame(frame_body(request))
         store.insert_column_arrays(
             name, columns, start_id=extra.get("start_id")
         )
@@ -323,7 +353,7 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     @guarded
     @mutating
     def set_column_bin(request, name):
-        columns, extra = decode_frame(request.get_data())
+        columns, extra = decode_frame(frame_body(request))
         field = extra["field"]
         store.set_column(
             name, field, columns[field], start_id=extra.get("start_id", 1)
@@ -394,6 +424,7 @@ class RemoteStore(DocumentStore):
         timeout: float = 600.0,
         wire_rows: Optional[int] = None,
         failover_timeout: Optional[float] = None,
+        compress: Optional[bool] = None,
     ):
         # A comma-separated ``base_url`` names the replica pair; the
         # client talks to one server at a time and re-points itself at
@@ -419,7 +450,47 @@ class RemoteStore(DocumentStore):
         self.wire_rows_bin = max(
             1, int(os.environ.get("LO_WIRE_ROWS_BIN", "2000000"))
         )
+        # LO_STORE_COMPRESS=1: zlib the binary frames both ways (the
+        # client advertises on reads, stamps its uploads) — worth it on
+        # narrow links (tunneled chips, cross-zone stores), off by
+        # default where the store is co-located and CPU is the scarcer
+        # resource.
+        self.compress = (
+            os.environ.get("LO_STORE_COMPRESS", "0") == "1"
+            if compress is None
+            else compress
+        )
+        # Retries for ONE failed chunk of a paged binary read before the
+        # whole read surfaces the error (the stream resumes at the
+        # failed chunk, never from chunk 0).
+        self.chunk_retries = max(
+            0, int(os.environ.get("LO_CHUNK_RETRIES", "2"))
+        )
         self._local = threading.local()
+        # Lazily-built read-ahead pool: chunk N+1's network fetch
+        # overlaps chunk N's decode (+ inflate). Per-STORE and
+        # persistent so the helper threads' requests.Sessions survive
+        # across reads (connection reuse — a per-read thread would pay
+        # a TCP handshake per read-ahead); width 4 so several
+        # concurrent paged readers overlap instead of serializing
+        # through one thread (each read keeps at most one prefetch in
+        # flight).
+        self._prefetch_pool = None
+        self._prefetch_lock = threading.Lock()
+
+    @property
+    def _prefetch(self):
+        pool = self._prefetch_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._prefetch_lock:
+                if self._prefetch_pool is None:
+                    self._prefetch_pool = ThreadPoolExecutor(
+                        max_workers=4, thread_name_prefix="lo-read-ahead"
+                    )
+                pool = self._prefetch_pool
+        return pool
 
     # one session per thread: requests.Session pools connections but is
     # not formally thread-safe
@@ -549,29 +620,45 @@ class RemoteStore(DocumentStore):
     def _post_frame(
         self, path: str, frame: bytes, landed_ok: bool = False
     ) -> dict:
+        headers = {"Content-Type": BIN_CONTENT_TYPE}
+        if self.compress and len(frame) >= COMPRESS_MIN_BYTES:
+            frame = compress_frame(frame)
+            headers[ENCODING_HEADER] = WIRE_COMPRESSION
         return self._send(
             lambda base: self._session.post(
                 f"{base}{path}",
                 data=frame,
-                headers={"Content-Type": BIN_CONTENT_TYPE},
+                headers=headers,
                 timeout=self.timeout,
             ),
             landed_ok=landed_ok,
         ).json()
 
+    def _fetch_frame_bytes(self, path: str, body: dict) -> bytes:
+        """POST JSON, receive raw frame bytes (wire compression undone).
+
+        Kept separate from the decode so the double-buffered read loop
+        can run the network fetch on a helper thread while the main
+        thread decodes the previous chunk."""
+        data = json.dumps(body)
+        headers = {"Content-Type": "application/json"}
+        if self.compress:
+            headers[ACCEPT_HEADER] = WIRE_COMPRESSION
+        response = self._send(
+            lambda base: self._session.post(
+                f"{base}{path}",
+                data=data,
+                headers=headers,
+                timeout=self.timeout,
+            )
+        )
+        return decode_body(
+            response.content, response.headers.get(ENCODING_HEADER)
+        )
+
     def _post_for_frame(self, path: str, body: dict):
         """POST JSON, receive a binary columnar frame."""
-        data = json.dumps(body)
-        return decode_frame(
-            self._send(
-                lambda base: self._session.post(
-                    f"{base}{path}",
-                    data=data,
-                    headers={"Content-Type": "application/json"},
-                    timeout=self.timeout,
-                )
-            ).content
-        )
+        return decode_frame(self._fetch_frame_bytes(path, body))
 
     def _get(self, path: str) -> dict:
         return self._send(
@@ -784,6 +871,48 @@ class RemoteStore(DocumentStore):
         )
         return out
 
+    def _fetch_chunk(
+        self, collection: str, fields, chunk_start: int, chunk_limit: int
+    ) -> bytes:
+        """One chunk's frame bytes, retried IN PLACE on TRANSIENT
+        failure (connection death, timeout, 5xx): a mid-stream fault
+        purges any partially-populated device-cache entry for the
+        collection (a torn entry must never outlive the read that was
+        filling it) and re-requests THIS chunk — never chunk 0; earlier
+        chunks' bytes are already decoded and the rev check still
+        proves consistency of the final result. Deterministic errors
+        (4xx mappings, a follower's 503→PermissionError) propagate
+        immediately — retrying them would only add sleeps and evict
+        perfectly valid cache entries."""
+        attempt = 0
+        while True:
+            try:
+                return self._fetch_frame_bytes(
+                    f"/c/{collection}/read_columns_bin",
+                    {
+                        "fields": fields,
+                        "start": chunk_start,
+                        "limit": chunk_limit,
+                    },
+                )
+            except (
+                requests.ConnectionError,
+                requests.Timeout,
+                requests.HTTPError,
+            ) as error:
+                response = getattr(error, "response", None)
+                if response is not None and response.status_code < 500:
+                    raise  # deterministic client error: not retryable
+                from learningorchestra_tpu.core import devcache
+
+                devcache.invalidate_collection(collection, store=self)
+                if attempt >= self.chunk_retries:
+                    raise
+                attempt += 1
+                import time
+
+                time.sleep(min(0.2 * attempt, 1.0))
+
     def _read_column_arrays_once(
         self,
         collection: str,
@@ -795,42 +924,103 @@ class RemoteStore(DocumentStore):
         out: dict[str, Column] = {}
         fetched = 0
         rev: Optional[int] = None
-        while True:
-            chunk_limit = self.wire_rows_bin
-            if limit is not None:
-                chunk_limit = min(chunk_limit, limit - fetched)
-                if chunk_limit <= 0:
+        pending = None  # (future, predicted_start, predicted_limit)
+        try:
+            while True:
+                chunk_limit = self.wire_rows_bin
+                if limit is not None:
+                    chunk_limit = min(chunk_limit, limit - fetched)
+                    if chunk_limit <= 0:
+                        break
+                chunk_start = start + fetched
+                if (
+                    pending is not None
+                    and pending[1] == chunk_start
+                    and pending[2] == chunk_limit
+                ):
+                    future = pending[0]
+                    pending = None
+                    try:
+                        raw = future.result()
+                    except Exception:
+                        # the read-ahead died terminally (its own
+                        # in-place retries exhausted): one more
+                        # synchronous attempt before the read as a
+                        # whole fails
+                        raw = self._fetch_chunk(
+                            collection, fields, chunk_start, chunk_limit
+                        )
+                else:
+                    pending = self._discard_prefetch(pending)
+                    raw = self._fetch_chunk(
+                        collection, fields, chunk_start, chunk_limit
+                    )
+                # Double buffering: assume this chunk comes back full
+                # and start fetching the next stride NOW, overlapping
+                # the decode below. A short chunk ends the stream and
+                # the speculative fetch is discarded (it reads rows
+                # past the end — an empty frame, one wasted round trip
+                # at most).
+                next_start = chunk_start + chunk_limit
+                next_limit = self.wire_rows_bin
+                if limit is not None:
+                    next_limit = min(next_limit, start + limit - next_start)
+                if next_limit > 0 and chunk_limit > 1:
+                    pending = (
+                        self._prefetch.submit(
+                            self._fetch_chunk,
+                            collection,
+                            fields,
+                            next_start,
+                            next_limit,
+                        ),
+                        next_start,
+                        next_limit,
+                    )
+                columns, extra = decode_frame(raw)
+                chunk_rev = extra.get("rev", -1)
+                if rev is None:
+                    rev = chunk_rev
+                elif check_rev and rev != -1 and chunk_rev != rev:
+                    return out, True  # a write interleaved: torn read
+                elif chunk_rev != rev:
+                    rev = chunk_rev  # unchecked mode: follow the rev
+                if not out:
+                    out = columns
+                else:
+                    for name, column in columns.items():
+                        existing = out.get(name)
+                        if existing is None:
+                            # field appeared mid-read (unchecked mode):
+                            # earlier rows lack it → pad prefix
+                            existing = Column.pads(fetched)
+                        out[name] = existing.append_column(column)
+                chunk_rows = max(
+                    (len(c) for c in columns.values()), default=0
+                )
+                fetched += chunk_rows
+                if chunk_rows < chunk_limit or chunk_rows == 0:
                     break
-            columns, extra = self._post_for_frame(
-                f"/c/{collection}/read_columns_bin",
-                {
-                    "fields": fields,
-                    "start": start + fetched,
-                    "limit": chunk_limit,
-                },
-            )
-            chunk_rev = extra.get("rev", -1)
-            if rev is None:
-                rev = chunk_rev
-            elif check_rev and rev != -1 and chunk_rev != rev:
-                return out, True  # a write interleaved: torn read
-            elif chunk_rev != rev:
-                rev = chunk_rev  # unchecked mode: follow the rev along
-            if not out:
-                out = columns
-            else:
-                for name, column in columns.items():
-                    existing = out.get(name)
-                    if existing is None:
-                        # field appeared mid-read (unchecked mode):
-                        # earlier rows lack it → pad prefix
-                        existing = Column.pads(fetched)
-                    out[name] = existing.append_column(column)
-            chunk_rows = max((len(c) for c in columns.values()), default=0)
-            fetched += chunk_rows
-            if chunk_rows < chunk_limit or chunk_rows == 0:
-                break
-        return out, False
+            return out, False
+        finally:
+            # Every exit — short chunk, torn-read return, decode error —
+            # must consume the speculative fetch (never an unretrieved
+            # exception, never an orphaned request blocking a retry).
+            self._discard_prefetch(pending)
+
+    @staticmethod
+    def _discard_prefetch(pending):
+        """Drop a speculative fetch whose prediction didn't pan out
+        (short/terminal chunk). Its failure, if any, is irrelevant —
+        swallow it so a dead read-ahead never fails a finished read."""
+        if pending is not None:
+            future = pending[0]
+            if not future.cancel():
+                future.add_done_callback(lambda f: f.exception())
+        return None
+
+    def collection_rev(self, collection: str) -> int:
+        return self._get(f"/c/{collection}/rev")["rev"]
 
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
         return self._post(f"/c/{collection}/aggregate", {"pipeline": pipeline})[

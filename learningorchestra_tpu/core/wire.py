@@ -22,12 +22,42 @@ from __future__ import annotations
 
 import json
 import struct
+import zlib
 from typing import Optional
 
 from learningorchestra_tpu.core.columns import Column
 
 MAGIC = b"LOCB1\n"
 CONTENT_TYPE = "application/x-lo-columns"
+
+# Optional whole-frame compression (LO_STORE_COMPRESS), negotiated per
+# request: the client advertises ACCEPT_HEADER on binary reads (and
+# stamps ENCODING_HEADER on compressed uploads); the server compresses a
+# response ONLY when the request advertised, and always stamps
+# ENCODING_HEADER on what it compressed. Custom headers — not HTTP
+# Content-Encoding — so no WSGI middleware ever transcodes the frame
+# behind the framing's back. stdlib zlib at level 1: typed float columns
+# compress 2-4x and the deflate cost overlaps the next chunk's fetch in
+# the double-buffered read loop (store_service.RemoteStore).
+ACCEPT_HEADER = "X-Lo-Columns-Accept"
+ENCODING_HEADER = "X-Lo-Columns-Encoding"
+WIRE_COMPRESSION = "zlib"
+COMPRESS_LEVEL = 1
+# Frames below this aren't worth a deflate pass (headers dominate).
+COMPRESS_MIN_BYTES = 4096
+
+
+def compress_frame(frame: bytes) -> bytes:
+    return zlib.compress(frame, COMPRESS_LEVEL)
+
+
+def decode_body(data: bytes, encoding: Optional[str]) -> bytes:
+    """Undo wire compression per the peer's ENCODING_HEADER value."""
+    if not encoding:
+        return data
+    if encoding != WIRE_COMPRESSION:
+        raise ValueError(f"unknown columns wire encoding {encoding!r}")
+    return zlib.decompress(data)
 
 
 def encode_frame(

@@ -243,13 +243,18 @@ class DataFrame:
 
     def device_matrix(self, features_col: str, mesh=None):
         """The assembled feature matrix padded + row-sharded on the
-        mesh, cached on the frame: when N classifiers predict over the
-        same test/eval frame, the host→device transfer happens ONCE,
-        not per model — the reference re-reads its dataframes per
-        evaluator instead (model_builder.py:205-224)."""
+        mesh, cached twice: on the frame (when N classifiers predict
+        over the same test/eval frame, the host→device transfer happens
+        ONCE, not per model — the reference re-reads its dataframes per
+        evaluator instead, model_builder.py:205-224) and in the
+        process-wide device cache, content-addressed (core/devcache.py)
+        — so the SAME bytes across requests (a rebuilt frame from an
+        unchanged collection + preprocessor) reuse one device copy
+        instead of paying H2D per request."""
         import threading
 
-        from learningorchestra_tpu.ml.base import resolve_mesh, shard_matrix
+        from learningorchestra_tpu.core.devcache import content_device_matrix
+        from learningorchestra_tpu.ml.base import resolve_mesh
 
         mesh = resolve_mesh(mesh)
         cache = self.__dict__.setdefault("_device_matrices", {})
@@ -258,16 +263,20 @@ class DataFrame:
         with lock:
             cached = cache.get(key)
             if cached is None:
-                cached = shard_matrix(self.feature_matrix(features_col), mesh)
+                cached = content_device_matrix(
+                    self.feature_matrix(features_col), mesh
+                )
                 cache[key] = cached
         return cached
 
     def device_labels(self, label_col: str, mesh=None):
         """The label vector padded + row-sharded on the mesh, cached on
-        the frame (see :meth:`device_matrix`)."""
+        the frame and content-addressed in the process-wide device
+        cache (see :meth:`device_matrix`)."""
         import threading
 
-        from learningorchestra_tpu.ml.base import resolve_mesh, shard_labels
+        from learningorchestra_tpu.core.devcache import content_device_labels
+        from learningorchestra_tpu.ml.base import resolve_mesh
 
         mesh = resolve_mesh(mesh)
         cache = self.__dict__.setdefault("_device_matrices", {})
@@ -276,6 +285,8 @@ class DataFrame:
         with lock:
             cached = cache.get(key)
             if cached is None:
-                cached = shard_labels(self.label_vector(label_col), mesh)
+                cached = content_device_labels(
+                    self.label_vector(label_col), mesh
+                )
                 cache[key] = cached
         return cached

@@ -196,28 +196,34 @@ class FittedModel:
         chip are latency-bound, so every entry point funnels through
         here. Labels are rebuilt host-side when they are argmax(probs)
         (``labels_from_probs``), so the label buffer never travels.
-        Multi-host arrays gather via ``fetch``."""
-        if jax.process_count() > 1:
-            from learningorchestra_tpu.parallel.multihost import fetch
+        Multi-host arrays gather via ``fetch``. The blocking transfer is
+        a ``d2h`` span in the active trace (a no-op outside one), so the
+        device→host tail shows up in ``/jobs/<name>/trace`` next to the
+        ``h2d`` spans the data plane emits."""
+        from learningorchestra_tpu.telemetry import span as _span
 
-            probs_np = np.asarray(fetch(probs))[:n]
-            labels_np = (
-                np.argmax(probs_np, axis=1)
-                if self.labels_from_probs
-                else np.asarray(fetch(labels))[:n]
+        with _span("d2h:predictions", rows=n):
+            if jax.process_count() > 1:
+                from learningorchestra_tpu.parallel.multihost import fetch
+
+                probs_np = np.asarray(fetch(probs))[:n]
+                labels_np = (
+                    np.argmax(probs_np, axis=1)
+                    if self.labels_from_probs
+                    else np.asarray(fetch(labels))[:n]
+                )
+                fetched = jax.device_get(tuple(scalars)) if scalars else ()
+                return labels_np, probs_np, tuple(fetched)
+            if self.labels_from_probs:
+                out = jax.device_get((probs,) + tuple(scalars))
+                probs_np = np.asarray(out[0])[:n]
+                return np.argmax(probs_np, axis=1), probs_np, tuple(out[1:])
+            out = jax.device_get((labels, probs) + tuple(scalars))
+            return (
+                np.asarray(out[0])[:n],
+                np.asarray(out[1])[:n],
+                tuple(out[2:]),
             )
-            fetched = jax.device_get(tuple(scalars)) if scalars else ()
-            return labels_np, probs_np, tuple(fetched)
-        if self.labels_from_probs:
-            out = jax.device_get((probs,) + tuple(scalars))
-            probs_np = np.asarray(out[0])[:n]
-            return np.argmax(probs_np, axis=1), probs_np, tuple(out[1:])
-        out = jax.device_get((labels, probs) + tuple(scalars))
-        return (
-            np.asarray(out[0])[:n],
-            np.asarray(out[1])[:n],
-            tuple(out[2:]),
-        )
 
     def _eval(self, X) -> tuple[np.ndarray, np.ndarray]:
         labels, probs, _ = self._device_eval(X)

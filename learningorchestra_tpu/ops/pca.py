@@ -46,11 +46,21 @@ def _pca(X, mask, n_components: int):
 
 
 def pca_embedding(
-    X: np.ndarray, n_components: int = 2, mesh: Optional[Mesh] = None
+    X, n_components: int = 2, mesh: Optional[Mesh] = None
 ) -> np.ndarray:
     """Project rows onto the top principal components. Returns
-    ``(rows, n_components)``."""
+    ``(rows, n_components)``.
+
+    ``X`` may be a host array or an already-sharded
+    :class:`~learningorchestra_tpu.ml.base.DeviceMatrix` (the device
+    cache's currency, core/devcache.py): a cached matrix enters with
+    ZERO host↔device input traffic — ``prepare_xy`` passes its buffers
+    straight through and only the ``(rows, n_components)`` embedding
+    crosses back (the ``d2h`` span below is the whole transfer bill)."""
+    from learningorchestra_tpu.telemetry import span
+
     mesh = resolve_mesh(mesh)
     X_dev, _, mask = prepare_xy(X, None, mesh)
     embedded, _, _ = _pca(X_dev, mask, n_components)
-    return fetch(embedded)[: len(X)]
+    with span("d2h:pca", rows=len(X), components=n_components):
+        return fetch(embedded)[: len(X)]

@@ -262,10 +262,34 @@ def _tsne_exact(
     replicated = NamedSharding(mesh, PSpec())
     X_dev = jax.device_put(jnp.asarray(X_pad), replicated)
     valid_dev = jax.device_put(jnp.asarray(valid), replicated)
+    return _tsne_exact_on_device(
+        X_dev, valid_dev, n, mesh, perplexity, iterations, learning_rate,
+        seed, chunk,
+    )
+
+
+def _tsne_exact_on_device(
+    X_dev,
+    valid_dev,
+    n: int,
+    mesh: Mesh,
+    perplexity: float,
+    iterations: int,
+    learning_rate: float,
+    seed: int,
+    chunk: int,
+) -> np.ndarray:
+    """Exact t-SNE over already-replicated device buffers — the shared
+    tail of the host-array path and the cached-DeviceMatrix path (which
+    reshards the cached row-sharded buffers on device instead of
+    re-crossing the PCIe boundary)."""
     perplexity = min(perplexity, max((n - 1) / 3.0, 1.0))
+    replicated = NamedSharding(mesh, PSpec())
     P = _affinities(mesh, X_dev, valid_dev, jnp.float32(perplexity), chunk)
     Y0 = (
-        jax.random.normal(jax.random.key(seed), (len(X_pad), 2), jnp.float32)
+        jax.random.normal(
+            jax.random.key(seed), (X_dev.shape[0], 2), jnp.float32
+        )
         * 1e-4
     )
     Y0 = jax.device_put(Y0, replicated)
@@ -279,7 +303,10 @@ def _tsne_exact(
         jnp.float32(learning_rate),
         jnp.float32(EARLY_EXAGGERATION),
     )
-    return fetch(Y)[:n]
+    from learningorchestra_tpu.telemetry import span
+
+    with span("d2h:tsne", rows=n):
+        return fetch(Y)[:n]
 
 
 @partial(jax.jit, static_argnames=("mesh", "chunk"))
@@ -375,7 +402,7 @@ def _tsne_landmark(
 
 
 def tsne_embedding(
-    X: np.ndarray,
+    X,
     perplexity: float = PERPLEXITY,
     iterations: int = ITERATIONS,
     learning_rate: float = LEARNING_RATE,
@@ -391,8 +418,48 @@ def tsne_embedding(
     ``"landmark"`` (exact on a subsample + calibrated kernel regression
     for the rest — linear in n), or ``"auto"`` (exact up to
     ``exact_rows_limit`` rows).
+
+    ``X`` may be an already-sharded :class:`~learningorchestra_tpu.ml.
+    base.DeviceMatrix` (the device cache's currency, core/devcache.py).
+    The exact path reshards the cached buffers on device — the dataset
+    never re-crosses the PCIe boundary and only the ``(rows, 2)``
+    embedding comes back. The landmark path needs host rows for
+    subsampling and macro-batching, so a cached matrix pays one D2H
+    there — still strictly cheaper than re-reading the store over the
+    wire. (Same padded-shape rule both ways: ``shard_rows`` and
+    ``_pad_for_mesh`` share ``padded_row_count``.)
     """
+    from learningorchestra_tpu.ml.base import DeviceMatrix
+
     mesh = resolve_mesh(mesh)
+    if isinstance(X, DeviceMatrix):
+        n = len(X)
+        if method == "auto":
+            method = "exact" if n <= exact_rows_limit else "landmark"
+        if (
+            method == "exact"
+            and X.mesh is mesh
+            and jax.process_count() == 1
+        ):
+            shards = data_size(mesh)
+            chunk = max(1, min(CHUNK, X.data.shape[0] // shards))
+            replicated_sharding = NamedSharding(mesh, PSpec())
+            return _tsne_exact_on_device(
+                jax.device_put(X.data.astype(jnp.float32), replicated_sharding),
+                jax.device_put(X.mask, replicated_sharding),
+                n,
+                mesh,
+                perplexity,
+                iterations,
+                learning_rate,
+                seed,
+                chunk,
+            )
+        # landmark (or mesh/process mismatch): one D2H of the cached
+        # buffer replaces the wire read (fetch gathers across hosts —
+        # every process enters tsne_embedding, so the collective lines
+        # up)
+        X = np.asarray(fetch(X.data))[:n]
     X = np.asarray(X, np.float32)
     if method == "auto":
         method = "exact" if len(X) <= exact_rows_limit else "landmark"

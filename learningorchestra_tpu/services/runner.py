@@ -62,6 +62,11 @@ Environment:
   the in-process store (core/store.py): past the budget, cold column
   payloads move to disk-backed mappings. Applies to the store SERVER
   process in the microservice topology.
+- ``LO_DEVCACHE_BYTES`` / ``LO_STORE_COMPRESS`` / ``LO_WRITE_OVERLAP``
+  — data-plane knobs (docs/dataplane.md): the rev-keyed device cache's
+  capacity (core/devcache.py; 0 disables), zlib compression on the
+  binary store wire, and the builder's overlapped prediction
+  write-back (0 restores synchronous writes).
 - ``LO_INGEST_SLAB_BYTES`` — CSVs past this size parse as bounded slabs
   (core/ingest.py), keeping ingest's transient working set slab-sized.
 - ``LO_AUTO_PROMOTE_S`` / ``LO_PEERS`` / ``LO_FAILOVER_TIMEOUT_S`` —
@@ -326,6 +331,13 @@ def main() -> None:
         flush=True,
     )
     multi_host = initialize_from_env()
+
+    # Fail fast on a malformed device-cache budget — the same startup
+    # posture as the scheduler knobs: a typo'd LO_DEVCACHE_BYTES must
+    # not silently run at the default capacity.
+    from learningorchestra_tpu.core.devcache import capacity_bytes
+
+    print(f"devcache capacity: {capacity_bytes()} bytes", flush=True)
 
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache
