@@ -51,14 +51,26 @@ Manifest (JSON)::
         "store_compress": 0,       #   LO_STORE_COMPRESS (1 = zlib wire)
         "write_overlap": 1         #   LO_WRITE_OVERLAP (0 = sync writes)
       },
+      "replication": {             # optional replicated store plane
+        "enabled": true,           #   (docs/replication.md): the head
+        "follower_port": 27028,    #   runs primary + WAL-shipping
+        "arbiter_port": 27029,     #   follower + quorum arbiter; every
+        "auto_promote_s": 5,       #   machine's LO_STORE_URL names both
+        "sync_repl": 0             #   stores. sync_repl=1 withholds acks
+      },                           #   until a follower holds the write
       "restart_delay": 5,
       "max_cluster_restarts": null # null = retry forever
     }
 
 ``render`` prints the exact per-machine command lines (env + stack.py)
 so an operator can run or inspect them by hand; ``up`` is those commands
-plus supervision. ssh transport runs ``exec`` remotely so dropping the
-ssh connection (driver exit/restart) HUPs the remote stack.
+plus supervision. ssh transport sets ``LO_STACK_EXIT_ON_STDIN_EOF=1``
+so the remote stack shuts itself down when the ssh channel closes —
+``ssh -o BatchMode=yes`` allocates no pty, so a dying driver would
+otherwise never HUP the remote process group and the stale stack would
+linger holding the store/coordinator ports; ``Machine.stop`` ALSO
+issues an explicit remote ``pkill`` before every whole-cluster
+relaunch, so a relaunch never collides with a surviving old group.
 """
 
 from __future__ import annotations
@@ -132,6 +144,44 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("dataplane.devcache_bytes must be >= 0")
         elif value not in (0, 1):
             raise SystemExit(f"dataplane.{key} must be 0 or 1")
+    replication = manifest.setdefault("replication", {})
+    for key in replication:
+        if key not in _REPLICATION_KNOBS:
+            raise SystemExit(
+                f"unknown replication knob {key!r} (have: "
+                f"{', '.join(sorted(_REPLICATION_KNOBS))})"
+            )
+    if replication:
+        enabled = replication.get("enabled", True)
+        if not isinstance(enabled, bool):
+            raise SystemExit("replication.enabled must be true/false")
+        replication["enabled"] = enabled
+        for key in ("follower_port", "arbiter_port"):
+            value = replication.setdefault(
+                key, 27028 if key == "follower_port" else 27029
+            )
+            if (
+                not isinstance(value, int)
+                or isinstance(value, bool)
+                or not 1 <= value <= 65535
+            ):
+                raise SystemExit(f"replication.{key} must be a port number")
+        ports = {
+            manifest["store_port"],
+            replication["follower_port"],
+            replication["arbiter_port"],
+        }
+        if enabled and len(ports) != 3:
+            raise SystemExit(
+                "replication needs three DISTINCT ports (store_port, "
+                "follower_port, arbiter_port)"
+            )
+        auto = replication.setdefault("auto_promote_s", 5)
+        if isinstance(auto, bool) or not isinstance(auto, (int, float)) or auto <= 0:
+            raise SystemExit("replication.auto_promote_s must be > 0")
+        sync = replication.setdefault("sync_repl", 0)
+        if isinstance(sync, bool) or sync not in (0, 1):
+            raise SystemExit("replication.sync_repl must be 0 or 1")
     return manifest
 
 
@@ -151,6 +201,22 @@ _DATAPLANE_KNOBS = {
     "write_overlap": "LO_WRITE_OVERLAP",
 }
 
+# manifest replication.<knob> (docs/replication.md); the head machine
+# runs the whole store plane, every machine's LO_STORE_URL names the
+# primary AND the follower for client-side failover
+_REPLICATION_KNOBS = (
+    "enabled",
+    "follower_port",
+    "arbiter_port",
+    "auto_promote_s",
+    "sync_repl",
+)
+
+
+def _replication_enabled(manifest: dict) -> bool:
+    replication = manifest.get("replication") or {}
+    return bool(replication) and replication.get("enabled", True)
+
 
 def total_processes(manifest: dict) -> int:
     return (
@@ -165,9 +231,19 @@ def machine_plans(manifest: dict) -> list[dict]:
     head = manifest["head"]
     total = total_processes(manifest)
     store_url = f"http://{head['host']}:{manifest['store_port']}"
+    replication = manifest.get("replication") or {}
+    if _replication_enabled(manifest):
+        # workers and clients fail over between the pair client-side
+        store_url += (
+            f",http://{head['host']}:{replication['follower_port']}"
+        )
     coordinator = f"{head['host']}:{manifest['coord_port']}"
     shared = dict(manifest["env"])
     shared["LO_TOTAL_PROCESSES"] = str(total)
+    if manifest["transport"] == "ssh":
+        # the ssh channel is the launcher's lifeline: EOF on it tells
+        # the remote stack its driver is gone (see plan_command)
+        shared["LO_STACK_EXIT_ON_STDIN_EOF"] = "1"
     # scheduler knobs apply cluster-wide: every machine's services
     # admit through the same widths/caps (docs/scheduler.md). .get():
     # callers may hand-build plans without load_manifest's defaults.
@@ -190,6 +266,17 @@ def machine_plans(manifest: dict) -> list[dict]:
             "LO_DATA_DIR": head["data_dir"],
         }
     )
+    if _replication_enabled(manifest):
+        head_env.update(
+            {
+                "LO_REPLICATION": "1",
+                "LO_FOLLOWER_PORT": str(replication["follower_port"]),
+                "LO_ARBITER_PORT": str(replication["arbiter_port"]),
+                "LO_AUTO_PROMOTE_S": str(replication["auto_promote_s"]),
+            }
+        )
+        if replication.get("sync_repl"):
+            head_env["LO_STORE_SYNC_REPL"] = "1"
     plans = [
         {
             "name": "head",
@@ -262,9 +349,16 @@ class Machine:
                 REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
             )
             env["PYTHONUNBUFFERED"] = "1"
+        # stdin is a PIPE the driver holds open for the machine's
+        # lifetime: the remote stack watches the ssh channel's stdin for
+        # EOF (LO_STACK_EXIT_ON_STDIN_EOF) — an inherited stdin would
+        # hand it /dev/null's immediate EOF under nohup/systemd/CI and
+        # tear every stack down at bring-up (or let several ssh clients
+        # race for the operator's terminal keystrokes).
         self.proc = subprocess.Popen(
             plan_command(self.manifest, self.plan),
             env=env,
+            stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -314,6 +408,14 @@ class Machine:
             pass  # machine unreachable: nothing left to kill from here
 
     def stop(self, timeout: float = 15.0) -> None:
+        # closing stdin FIRST is the graceful path: the remote stack's
+        # stdin-EOF watchdog shuts the whole process tree down cleanly;
+        # terminate + the explicit remote pkill remain the backstop
+        if self.proc is not None and self.proc.stdin is not None:
+            try:
+                self.proc.stdin.close()
+            except OSError:
+                pass
         self.terminate()
         self._remote_kill()
         if self.proc is not None:

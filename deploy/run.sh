@@ -31,24 +31,68 @@
 #                         narrow links; default 0)
 #   LO_WRITE_OVERLAP      0 = synchronous prediction write-back
 #                         (default 1: writes overlap the next fit)
+#
+# Replication / failover knobs (docs/replication.md has the full table):
+#   LO_REPLICATION        1 = replicated store plane (primary + follower
+#                         + quorum arbiter) when run under deploy/stack.py
+#   LO_FOLLOWER_PORT      follower store port        (default 27028)
+#   LO_ARBITER_PORT       arbiter port               (default 27029)
+#   LO_AUTO_PROMOTE_S     follower takeover timer    (default 5)
+#   LO_QUORUM_GRACE_S     primary write-suspension grace after losing
+#                         its voter majority
+#   LO_STORE_SYNC_REPL    1 = acks wait for a follower (zero lost
+#                         acknowledged writes; LO_STORE_ACK_TIMEOUT_S)
+#
+# Fault injection (chaos drills ONLY — docs/replication.md):
+#   LO_FAULT_*            named fault points (kill/delay/error/torn);
+#                         validated below so a typo'd point or spec
+#                         fails bring-up instead of silently not firing
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 export LO_DATA_DIR="${1:-${LO_DATA_DIR:-$PWD/lo_data}}"
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 
-# Fail fast on malformed scheduler/data-plane knobs before bringing up
-# services.
+# Fail fast on malformed scheduler/data-plane/replication/fault knobs
+# before bringing up services.
 python - <<'EOF'
 import os
 from learningorchestra_tpu.sched import config
 config.host_width(); config.device_width(); config.queue_cap()
 from learningorchestra_tpu.core import devcache
 devcache.capacity_bytes()
-for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP"):
+for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
+             "LO_STORE_SYNC_REPL"):
     value = os.environ.get(knob, "").strip()
     if value and value not in ("0", "1"):
         raise SystemExit(f"{knob} must be 0 or 1, got {value!r}")
+for knob in ("LO_FOLLOWER_PORT", "LO_ARBITER_PORT"):
+    value = os.environ.get(knob, "").strip()
+    if value:
+        try:
+            port = int(value)
+        except ValueError:
+            port = -1
+        if not 1 <= port <= 65535:
+            raise SystemExit(f"{knob} must be a port number, got {value!r}")
+for knob in ("LO_AUTO_PROMOTE_S", "LO_QUORUM_GRACE_S",
+             "LO_STORE_ACK_TIMEOUT_S"):
+    value = os.environ.get(knob, "").strip()
+    if value:
+        try:
+            seconds = float(value)
+        except ValueError:
+            seconds = -1.0
+        if seconds <= 0:
+            raise SystemExit(f"{knob} must be seconds > 0, got {value!r}")
+# chaos fault points: a typo'd LO_FAULT_* must fail bring-up loudly
+from learningorchestra_tpu.testing import faults
+try:
+    armed = faults.validate_env()
+except ValueError as error:
+    raise SystemExit(f"LO_FAULT_* validation failed: {error}")
+if armed:
+    print(f"run.sh: FAULT INJECTION ARMED: {armed} (chaos drill?)")
 EOF
 
 # SPMD-safety preflight (docs/analysis.md): refuse to serve a build

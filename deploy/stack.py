@@ -39,6 +39,22 @@ Environment (all optional):
                         runtime; any runtime member dying restarts the
                         whole group (see _supervise_multihost)
 - ``LO_COORD_PORT``     jax.distributed coordinator port (default 12355)
+- ``LO_REPLICATION``    "1" = replicated store plane (docs/replication.md):
+                        primary store + WAL-shipping follower + quorum
+                        arbiter (the reference's Mongo replica set +
+                        ``mongodbarbiter``, docker-compose.yml:27-91);
+                        services get both store URLs and fail over
+                        client-side. Requires fixed store ports.
+- ``LO_FOLLOWER_PORT``  follower store port (default 27028)
+- ``LO_ARBITER_PORT``   arbiter port (default 27029)
+- ``LO_AUTO_PROMOTE_S`` follower takeover timer, quorum-gated (default 5)
+- ``LO_STACK_EXIT_ON_STDIN_EOF``  "1" = shut the stack down when stdin
+                        hits EOF. Set by deploy/cluster.py's ssh
+                        transport: killing the ssh CLIENT never signals
+                        the remote side (BatchMode allocates no pty, so
+                        no SIGHUP) — watching the ssh channel's stdin is
+                        what keeps a dead driver from stranding the old
+                        stack and its runtime group on every machine.
 
 Cross-MACHINE topologies run one stack.py per machine (driven by
 ``deploy/cluster.py up <manifest>``, the reference's ``run.sh`` +
@@ -151,6 +167,37 @@ class Child:
         return self.proc.poll() if self.proc else None
 
 
+def start_stdin_watchdog(stopping, log, stream=None):
+    """Launcher-death watchdog (LO_STACK_EXIT_ON_STDIN_EOF=1): EOF on
+    stdin means the ssh channel — and with it the cluster driver — is
+    gone; set ``stopping`` so the stack shuts down instead of lingering
+    to collide with the driver's relaunch (stale store/coordinator
+    ports, briefly two writable stores). ``ssh -o BatchMode=yes``
+    allocates no pty, so a dying driver never HUPs the remote process
+    group — watching the channel's stdin is the reliable signal.
+    Returns the watcher thread, or None when the knob is off."""
+    if os.environ.get("LO_STACK_EXIT_ON_STDIN_EOF") != "1":
+        return None
+    if stream is None:
+        stream = sys.stdin.buffer
+
+    def _stdin_watch() -> None:
+        try:
+            while stream.read(65536):
+                pass
+        except Exception:
+            pass
+        if not stopping.is_set():
+            log("[stack] stdin closed (launcher gone); shutting down")
+            stopping.set()
+
+    thread = threading.Thread(
+        target=_stdin_watch, name="stdin-eof-watchdog", daemon=True
+    )
+    thread.start()
+    return thread
+
+
 def wait_health(url: str, timeout: float) -> None:
     """The dockerize -wait analogue: block until the store answers."""
     deadline = time.time() + timeout
@@ -165,7 +212,45 @@ def wait_health(url: str, timeout: float) -> None:
     raise TimeoutError(f"store not healthy at {url} within {timeout}s")
 
 
+def _start_store_plane(children, store, host, log) -> str:
+    """Start the store child — plus the follower and arbiter when the
+    replicated plane is configured (LO_REPLICATION=1) — and return the
+    ``LO_STORE_URL`` services should use: a comma list naming the
+    primary AND the follower, so RemoteStore fails over client-side
+    when a takeover happens (core/store_service.py)."""
+    store.start()
+    store_live_port = store.wait_port(60)
+    store_url = f"http://{host}:{store_live_port}"
+    wait_health(store_url, 60)
+    log(f"[stack] store healthy at {store_url}")
+    urls = [store_url]
+    for name in ("store-follower", "store-arbiter"):
+        child = children.get(name)
+        if child is None:
+            continue
+        child.start()
+        child_port = child.wait_port(60)
+        if name == "store-follower":
+            urls.append(f"http://{host}:{child_port}")
+    if len(urls) > 1:
+        log(f"[stack] replicated store plane up: {','.join(urls)} + arbiter")
+    return ",".join(urls)
+
+
 def main() -> int:
+    # chaos-knob preflight (run.sh does the same): a typo'd LO_FAULT_*
+    # must refuse bring-up here too — cluster.py launches stack.py
+    # directly, never through run.sh
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from learningorchestra_tpu.testing import faults
+
+        faults.validate_env()
+    except ValueError as error:
+        print(f"[stack] LO_FAULT_* validation failed: {error}")
+        return 2
+    except ImportError:
+        pass  # minimal checkout: the store-plane children validate too
     data_dir = os.path.abspath(
         sys.argv[1]
         if len(sys.argv) > 1
@@ -192,6 +277,8 @@ def main() -> int:
     base_env["LO_DATA_DIR"] = data_dir
     base_env["LO_HOST"] = host
 
+    replication = os.environ.get("LO_REPLICATION") == "1"
+    process_base_early = int(os.environ.get("LO_PROCESS_BASE", "0") or 0)
     store_env = dict(base_env)
     store_env["LO_STORE_PORT"] = store_port
     store = Child(
@@ -202,6 +289,55 @@ def main() -> int:
     )
 
     children: dict[str, Child] = {"store": store}
+
+    if replication and process_base_early == 0:
+        # Replicated store plane: primary + WAL-shipping follower +
+        # quorum arbiter, wired by fixed ports (peer/arbiter URLs must
+        # be known before any of the three starts).
+        if store_port == "0":
+            log("[stack] LO_REPLICATION=1 needs a fixed LO_STORE_PORT")
+            return 2
+        follower_port = os.environ.get("LO_FOLLOWER_PORT", "27028")
+        arbiter_port = os.environ.get("LO_ARBITER_PORT", "27029")
+        auto_promote_s = os.environ.get("LO_AUTO_PROMOTE_S", "5")
+        primary_url = f"http://{host}:{store_port}"
+        follower_url = f"http://{host}:{follower_port}"
+        arbiter_url = f"http://{host}:{arbiter_port}"
+        store_env.update(
+            {
+                "LO_REPLICATE": "1",
+                "LO_PEERS": follower_url,
+                "LO_ARBITERS": arbiter_url,
+                "LO_NODE_ID": "store-primary",
+            }
+        )
+        follower_env = dict(base_env)
+        follower_env.update(
+            {
+                "LO_STORE_PORT": follower_port,
+                # its own WAL dir — two stores must never share a log
+                "LO_DATA_DIR": os.path.join(data_dir, "follower"),
+                "LO_PRIMARY_URL": primary_url,
+                "LO_PEERS": primary_url,
+                "LO_ARBITERS": arbiter_url,
+                "LO_AUTO_PROMOTE_S": auto_promote_s,
+                "LO_NODE_ID": "store-follower",
+            }
+        )
+        arbiter_env = dict(base_env)
+        arbiter_env["LO_ARBITER_PORT"] = arbiter_port
+        children["store-follower"] = Child(
+            "store-follower",
+            [sys.executable, "-m", "learningorchestra_tpu.core.store_service"],
+            follower_env,
+            log,
+        )
+        children["store-arbiter"] = Child(
+            "store-arbiter",
+            [sys.executable, "-m", "learningorchestra_tpu.core.arbiter"],
+            arbiter_env,
+            log,
+        )
 
     def write_ports() -> None:
         ports = {
@@ -234,6 +370,8 @@ def main() -> int:
 
     signal.signal(signal.SIGTERM, shutdown)
     signal.signal(signal.SIGINT, shutdown)
+
+    start_stdin_watchdog(stopping, log)
 
     workers = int(os.environ.get("LO_WORKERS", "0") or 0)
     process_base = int(os.environ.get("LO_PROCESS_BASE", "0") or 0)
@@ -310,16 +448,13 @@ def _supervise(
     stopping,
     log,
 ) -> int:
-    store.start()
-    store_live_port = store.wait_port(60)
-    store_url = f"http://{host}:{store_live_port}"
-    wait_health(store_url, 60)
-    log(f"[stack] store healthy at {store_url}")
+    service_store_url = _start_store_plane(children, store, host, log)
+    store_url = service_store_url.split(",")[0]
 
     for name in SERVICE_NAMES:
         env = dict(base_env)
         env["LO_SERVICE"] = name
-        env["LO_STORE_URL"] = store_url
+        env["LO_STORE_URL"] = service_store_url
         if ephemeral:
             env["LO_PORT"] = "0"
         child = Child(
@@ -388,7 +523,17 @@ def _supervise(
                         svc.env["LO_STORE_URL"] = store_url
             else:
                 child.start()
-                child.wait_port(120)
+                try:
+                    child.wait_port(120)
+                except TimeoutError as error:
+                    if name in ("store-follower", "store-arbiter"):
+                        # a redundancy component that cannot come back
+                        # (port held by a lingering socket, crash loop)
+                        # must not take down the healthy primary and
+                        # services; leave it dead, retry next cycle
+                        log(f"[stack] {name} restart stalled: {error}")
+                        continue
+                    raise
             write_ports()
 
     return exit_code
@@ -496,11 +641,8 @@ def _supervise_multihost(
     remote workers joining via ``LO_COORDINATOR``/``LO_PROCESS_ID`` —
     see deploy/README.md.
     """
-    store.start()
-    store_live_port = store.wait_port(60)
-    store_url = f"http://{host}:{store_live_port}"
-    wait_health(store_url, 60)
-    log(f"[stack] store healthy at {store_url}")
+    service_store_url = _start_store_plane(children, store, host, log)
+    store_url = service_store_url.split(",")[0]
 
     coord_port = os.environ.get("LO_COORD_PORT", "12355")
     num_processes = int(
@@ -513,7 +655,7 @@ def _supervise_multihost(
 
     def runtime_env(process_id: int) -> dict:
         env = dict(base_env)
-        env["LO_STORE_URL"] = store_url
+        env["LO_STORE_URL"] = service_store_url
         env["LO_COORDINATOR"] = f"{host}:{coord_port}"
         env["LO_NUM_PROCESSES"] = str(num_processes)
         env["LO_PROCESS_ID"] = str(process_id)
@@ -627,6 +769,41 @@ def _supervise_multihost(
             log("[stack] store exited cleanly; not restarting")
             retired.add("store")
             store.port = None
+            write_ports()
+        # replicated-plane members restart independently (their fixed
+        # ports keep the wiring valid; the primary's term fence handles
+        # a follower coming back after a completed takeover)
+        for plane_name in ("store-follower", "store-arbiter"):
+            child = children.get(plane_name)
+            if (
+                child is None
+                or child.poll() is None
+                or plane_name in retired
+                or stopping.is_set()
+            ):
+                continue
+            if child.poll() == 0:
+                log(f"[stack] {plane_name} exited cleanly; not restarting")
+                retired.add(plane_name)
+                continue
+            child.restarts += 1
+            log(
+                f"[stack] {plane_name} failed (rc={child.poll()}); "
+                f"restart #{child.restarts} in {restart_delay}s"
+            )
+            time.sleep(restart_delay)
+            child._port_event.clear()
+            child.port = None
+            child.start()
+            try:
+                child.wait_port(60)
+            except TimeoutError as error:
+                # a redundancy component failing to come back (port
+                # still held by a lingering socket, crash-looping)
+                # must NOT take down the healthy primary + services +
+                # runtime group: leave it dead, the next cycle retries
+                log(f"[stack] {plane_name} restart stalled: {error}")
+                continue
             write_ports()
         dead = [
             name

@@ -568,6 +568,16 @@ class _Collection:
         num_new = len(next(iter(columns.values()))) if columns else 0
         if self.block_columns:
             if start_id != self.block_stop:
+                if self.block_start <= start_id < self.block_stop:
+                    # overlapping append: the chunk's ids already exist —
+                    # a DUPLICATE-id condition (KeyError → wire 409), not
+                    # a malformed request; the client's landed-ok retry
+                    # machinery relies on the distinction to recognize a
+                    # replayed chunk that already landed
+                    raise KeyError(
+                        f"duplicate _id {start_id!r} (block rows "
+                        f"{self.block_start}..{self.block_stop - 1} exist)"
+                    )
                 raise ValueError(
                     f"columnar append must start at id {self.block_stop}, "
                     f"got {start_id}"
@@ -747,6 +757,13 @@ class InMemoryStore(DocumentStore):
             self._collections.setdefault(record["c"], _Collection())
         elif op == "drop":
             self._collections.pop(record["c"], None)
+            # replicated/replayed drops must reclaim spill files too:
+            # a follower applying a primary's drop through this switch
+            # used to strand the folder AND mis-route a recreated
+            # same-name collection into it (stale mapping via
+            # _maybe_spill's setdefault) — the drop() entry point below
+            # cleaned up, this one didn't (ADVICE r5 class)
+            self._drop_spill_folder(record["c"])
         elif op == "epoch":
             # Epoch is part of the log so it survives restarts: a
             # follower cursor is only valid against the SAME log, and a
@@ -761,6 +778,26 @@ class InMemoryStore(DocumentStore):
         """Records in the replication feed (0 when replication is off)."""
         with self._lock:
             return len(self._wal_buffer or ())
+
+    @property
+    def wal_epoch(self) -> int:
+        """Current feed epoch (bumps on compaction)."""
+        with self._lock:
+            return self._wal_epoch
+
+    @property
+    def wal_position(self) -> tuple[int, int]:
+        """``(epoch, length)`` under ONE lock acquisition — the
+        sync-repl ack wait must capture both atomically or a compaction
+        between two reads pairs a stale epoch with the new epoch's tiny
+        length and falsely satisfies the wait."""
+        with self._lock:
+            return self._wal_epoch, len(self._wal_buffer or ())
+
+    @property
+    def replicating(self) -> bool:
+        """True when this store keeps the in-memory feed followers tail."""
+        return self._wal_buffer is not None
 
     def wal_feed(self, epoch: int, offset: int, limit: int = 10000) -> dict:
         """Serialized WAL records from ``(epoch, offset)`` onward.
@@ -779,6 +816,7 @@ class InMemoryStore(DocumentStore):
                     "epoch": self._wal_epoch,
                     "offset": 0,
                     "next": 0,
+                    "length": len(self._wal_buffer),
                     "records": [],
                     "resync": True,
                 }
@@ -787,6 +825,9 @@ class InMemoryStore(DocumentStore):
                 "epoch": self._wal_epoch,
                 "offset": offset,
                 "next": offset + len(records),
+                # total feed length: followers compute replication lag
+                # (and the loss window of a takeover) from it
+                "length": len(self._wal_buffer),
                 "records": records,
                 "resync": False,
             }
@@ -1230,18 +1271,21 @@ class InMemoryStore(DocumentStore):
             self._log({"op": "create", "c": collection})
             return True
 
+    def _drop_spill_folder(self, collection: str) -> None:
+        """Reclaim a collection's spill files; memmaps still held by
+        snapshots keep reads valid (POSIX unlink semantics) until the
+        last reference dies."""
+        folder = self._spill_folders.pop(collection, None)
+        if folder is not None:
+            import shutil
+
+            shutil.rmtree(folder, ignore_errors=True)
+
     def drop(self, collection: str) -> None:
         with self._lock:
             self._collections.pop(collection, None)
             self._log({"op": "drop", "c": collection})
-            folder = self._spill_folders.pop(collection, None)
-            if folder is not None:
-                # reclaim the collection's spill files; memmaps still
-                # held by snapshots keep reads valid (POSIX unlink
-                # semantics) until the last reference dies
-                import shutil
-
-                shutil.rmtree(folder, ignore_errors=True)
+            self._drop_spill_folder(collection)
 
     def insert_one(self, collection: str, document: dict) -> None:
         with self._lock:

@@ -67,6 +67,22 @@ reference gets from its Mongo arbiter, docker-compose.yml:49-91):
   between the pair can open a write-accepting server on each side; the
   term fence below heals it in favor of the newest promotion when they
   reconnect.
+- ``LO_ARBITERS=<url,...>`` — QUORUM mode (docs/replication.md): the
+  vote-only arbiter (core/arbiter.py — the reference's
+  ``mongodbarbiter``) joins the voting population, and failover becomes
+  *prevented* rather than healed: a follower auto-promotes only after
+  winning a majority of votes for an explicit term, and a primary that
+  cannot reach a majority of voters SUSPENDS writes (503 +
+  ``Retry-After``; reads keep serving) until quorum returns — the
+  minority side of a partition degrades gracefully instead of opening
+  a second primary.
+- ``LO_STORE_SYNC_REPL=1`` — acknowledge mutations only once a
+  follower's WAL cursor has passed them (bounded by
+  ``LO_STORE_ACK_TIMEOUT_S``): the majority-write-concern analogue
+  that makes "zero lost acknowledged writes" hold across a primary
+  kill. Off by default; without it the loss window of a takeover is
+  *measured and reported* (promotion response, ``/health``,
+  ``lo_store_loss_window``) rather than zero.
 - Promotions bump a **term** (primary starts at 1; each takeover is
   ``max(seen primary term, own) + 1``), reported by ``/health``.
 - ``LO_PEERS=<url,url>`` — fencing: at startup AND every few seconds, a
@@ -90,12 +106,15 @@ from typing import Iterator, Optional
 
 import requests
 
+from learningorchestra_tpu.core.arbiter import grant_vote
 from learningorchestra_tpu.core.columns import Column
 from learningorchestra_tpu.core.store import (
+    ROW_ID,
     DocumentStore,
     InMemoryStore,
     UnsupportedQueryError,
 )
+from learningorchestra_tpu.testing import faults
 from learningorchestra_tpu.core.wire import (
     ACCEPT_HEADER,
     COMPRESS_MIN_BYTES,
@@ -112,6 +131,29 @@ from learningorchestra_tpu.utils.web import Response, ServerThread, WebApp
 DEFAULT_STORE_PORT = 27027
 
 
+class StoreUnavailableError(PermissionError):
+    """The store rejected or cannot currently accept a write — a
+    read-only follower's 503, a quorum-suspended primary's 503 +
+    Retry-After, or no writable server within the failover window.
+    Subclasses :class:`PermissionError` for existing handlers;
+    classified TRANSIENT by the scheduler's retry policy
+    (sched/policy.py) so jobs ride out a failover window with backoff
+    instead of failing terminally."""
+
+
+def _values_match(stored, sent) -> bool:
+    """Loose equality for landed-write verification: JSON round-trips
+    preserve Python scalar equality, but NaN != NaN needs handling."""
+    if stored == sent:
+        return True
+    try:
+        import math
+
+        return math.isnan(stored) and math.isnan(sent)
+    except TypeError:
+        return False
+
+
 def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebApp:
     """``role`` (mutable, shared with the caller) carries the HA state:
     ``{"writable": bool, "poller": ReplicationClient | None}``. A
@@ -124,12 +166,24 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     # bytes, spill bytes) at GET /metrics; remote-store CLIENTS don't
     from learningorchestra_tpu.telemetry import register_store
 
-    register_store(store)
     role = role if role is not None else {"writable": True, "poller": None}
     role.setdefault("term", 1 if role.get("writable", True) else 0)
     # serializes promote/demote transitions (HTTP promote vs the
     # auto-promote monitor vs the fencing probe)
     role.setdefault("lock", threading.Lock())
+    # quorum-mode degradation: a primary that lost its voter majority
+    # suspends writes (503 + Retry-After) while reads keep serving
+    role.setdefault("suspended", False)
+    # one-vote-per-term election ledger (grant_vote; docs/replication.md)
+    role.setdefault("voted_term", 0)
+    role.setdefault("voted_for", None)
+    # sync-replication ack ledger: highest (epoch, offset) any follower
+    # has requested the WAL from — a follower requests from its APPLIED
+    # position, so this is what a replica durably holds
+    role.setdefault("shipped", (-1, -1))
+    role.setdefault("repl_cv", threading.Condition())
+    role.setdefault("unreplicated_acks", 0)
+    register_store(store, role=role)
 
     def guarded(handler):
         def wrapped(request, **kwargs):
@@ -149,20 +203,100 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
         def wrapped(request, **kwargs):
             if not role.get("writable", True):
                 return {"error": "read-only follower; POST /promote"}, 503
-            return handler(request, **kwargs)
+            if role.get("suspended"):
+                # quorum lost: this (possibly minority-side) primary
+                # refuses writes instead of risking a second primary;
+                # Retry-After tells well-behaved clients (and the
+                # scheduler's transient-retry policy) to come back
+                response = Response(
+                    json.dumps(
+                        {
+                            "error": (
+                                "writes suspended: quorum lost "
+                                "(reads keep serving)"
+                            ),
+                            "kind": "writes_suspended",
+                        }
+                    ),
+                    mimetype="application/json",
+                    status=503,
+                )
+                response.headers["Retry-After"] = "1"
+                return response
+            faults.fire("store.wire.mutate", route=handler.__name__)
+            result = handler(request, **kwargs)
+            faults.fire("store.wire.mutate.applied", route=handler.__name__)
+            if (
+                role.get("sync_repl")
+                and getattr(store, "replicating", False)
+                and isinstance(result, tuple)
+                and result[1] == 200
+                and isinstance(result[0], dict)
+            ):
+                if not _await_replicated(role, store):
+                    # the wait timed out (follower down/lagging): the
+                    # write IS applied and logged locally — flag the ack
+                    # so callers and operators can see the degraded
+                    # durability instead of silently assuming majority
+                    with role["lock"]:
+                        role["unreplicated_acks"] += 1
+                    result = ({**result[0], "replicated": False}, 200)
+            return result
 
         wrapped.__name__ = handler.__name__
         return wrapped
 
     @app.route("/health", methods=("GET",))
     def health(request):
-        return {
+        payload = {
             "ok": True,
             "writable": role.get("writable", True),
+            "suspended": role.get("suspended", False),
             "term": role.get("term", 0),
+            # election evidence for the supersession check: a voter
+            # that granted a higher term exposes it here (the arbiter
+            # does the same) so a quorum-holding primary partitioned
+            # from the WINNER still hears about the election through
+            # any voter it can reach — store voters included, not just
+            # arbiters
+            "voted_term": role.get("voted_term", 0),
             "boot": role.get("boot", ""),  # equal-term fence tiebreak
             "columns_wire": "bin1",
-        }, 200
+        }
+        poller = role.get("poller")
+        if poller is not None:
+            payload["replication"] = {
+                "lag": poller.lag,
+                "caught_up": poller.caught_up,
+                "last_error": poller.last_error,
+            }
+        if role.get("loss_window") is not None:
+            # what this server's last takeover cost (docs/replication.md
+            # loss-window semantics); also exported as
+            # lo_store_loss_window on /metrics
+            payload["loss_window"] = role["loss_window"]
+        return payload, 200
+
+    @app.route("/vote", methods=("POST",))
+    def vote(request):
+        """One quorum-election vote (core/arbiter.py semantics): every
+        store server is also a voter. A live, unsuspended primary
+        vetoes — an election is only legitimate once the primary is
+        actually unreachable or degraded."""
+        body = request.get_json()
+        try:
+            term = int(body["term"])
+            candidate = str(body["candidate"])
+        except (KeyError, TypeError, ValueError):
+            return {"error": "vote needs integer term + candidate"}, 400
+        with role["lock"]:
+            if role.get("writable") and not role.get("suspended"):
+                return {
+                    "granted": False,
+                    "term": role.get("term", 0),
+                    "writable": True,
+                }, 200
+            return grant_vote(role, term, candidate), 200
 
     @app.route("/wal", methods=("GET",))
     def wal(request):
@@ -170,13 +304,40 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
             epoch = int(request.args.get("epoch", -1))
             offset = int(request.args.get("offset", 0))
             limit = int(request.args.get("limit", 10000))
+            wait_s = float(request.args.get("wait", 0))
         except ValueError:
-            return {"error": "epoch/offset/limit must be integers"}, 400
+            return {"error": "epoch/offset/limit/wait must be numbers"}, 400
+        faults.fire("store.wal.feed")
         try:
             feed = store.wal_feed(epoch, offset, limit=limit)
         except (AttributeError, ValueError):
             return {"error": "replication not enabled (LO_REPLICATE=1)"}, 404
+        if wait_s > 0 and not feed["records"] and not feed["resync"]:
+            # LONG-POLL: a caught-up follower parks here until a record
+            # lands (or the wait expires) instead of sleeping its poll
+            # interval — this is what keeps sync-repl ack latency at
+            # ~tens of milliseconds rather than one poll period per
+            # acknowledged mutation. Old followers that send no `wait`
+            # keep the plain immediate-answer behavior.
+            import time
+
+            wait_deadline = time.monotonic() + min(wait_s, 30.0)
+            while time.monotonic() < wait_deadline:
+                time.sleep(0.05)
+                current_epoch, current_length = store.wal_position
+                if current_epoch != epoch or current_length > offset:
+                    feed = store.wal_feed(epoch, offset, limit=limit)
+                    break
         feed["term"] = role.get("term", 0)  # followers track it for takeover
+        # Sync-repl ack ledger: a follower requests from its APPLIED
+        # position, so this request's (epoch, offset) is what a replica
+        # durably holds — wake writers waiting in _await_replicated.
+        cv = role.get("repl_cv")
+        if cv is not None and not feed["resync"]:
+            with cv:
+                if (epoch, offset) > tuple(role.get("shipped", (-1, -1))):
+                    role["shipped"] = (epoch, offset)
+                    cv.notify_all()
         return feed, 200
 
     @app.route("/compact", methods=("POST",))
@@ -328,6 +489,8 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
             )
             rev = -1
         frame = encode_frame(columns, extra={"rev": rev})
+        if faults.torn("store.wire.read_chunk"):
+            frame = frame[: max(1, len(frame) // 2)]  # truncated mid-buffer
         headers = {}
         if (
             WIRE_COMPRESSION in request.headers.get(ACCEPT_HEADER, "")
@@ -376,30 +539,77 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
     return app
 
 
-def promote_role(role: dict) -> dict:
+def _await_replicated(role: dict, store) -> bool:
+    """Block until a follower's WAL cursor has passed everything in the
+    log right now, or the ack timeout expires (sync-replication mode,
+    ``LO_STORE_SYNC_REPL=1``). Epoch-aware: a compaction mid-wait bumps
+    the epoch, and the snapshot carries the write — a follower draining
+    the NEW epoch's log satisfies the wait."""
+    import time
+
+    target_epoch, target_offset = store.wal_position
+    cv = role["repl_cv"]
+    deadline = time.monotonic() + float(role.get("ack_timeout_s", 2.0))
+    with cv:
+        while True:
+            shipped_epoch, shipped_offset = role.get("shipped", (-1, -1))
+            if shipped_epoch == target_epoch and shipped_offset >= target_offset:
+                return True
+            if shipped_epoch > target_epoch:
+                # compaction moved the feed mid-wait: the snapshot
+                # carries the write, so a follower draining the NEW
+                # epoch's log covers it
+                current_epoch, current_length = store.wal_position
+                if (
+                    shipped_epoch >= current_epoch
+                    and shipped_offset >= current_length
+                ):
+                    return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            cv.wait(remaining)
+
+
+def promote_role(role: dict, term: Optional[int] = None) -> dict:
     """Promote the server owning ``role`` to writable primary: stop the
-    WAL poller, bump the term past every term this follower has seen.
-    Idempotent; shared by ``POST /promote`` and the auto-promote
-    monitor."""
+    WAL poller, bump the term past every term this follower has seen
+    (or to the explicit quorum-granted ``term`` when the election voted
+    one), and record the measured loss window — last-replicated vs the
+    primary's last-acknowledged WAL position. Idempotent; shared by
+    ``POST /promote`` and the auto-promote monitor."""
+    faults.fire("store.promote")
     with role["lock"]:
         poller = role.get("poller")
         applied = None
         caught_up = None
+        loss = None
         if poller is not None:
             poller.stop()
             applied = {"epoch": poller.epoch, "offset": poller.offset}
             caught_up = poller.caught_up
+            # what this takeover COST: acknowledged-but-unshipped records
+            # as of the last successful poll (writes the dead primary
+            # accepted after that are unknowable from here — stated in
+            # docs/replication.md)
+            loss = poller.loss_window()
             # floor of 1: a follower that never completed a poll (primary
             # already dead at its start) must still promote PAST the
             # primary's term 1, or the strictly-greater fence would never
             # demote a partitioned-but-alive old primary
-            role["term"] = (
-                max(role.get("term", 0), poller.primary_term, 1) + 1
+            role["term"] = max(
+                max(role.get("term", 0), poller.primary_term, 1) + 1,
+                term or 0,
             )
             role["poller"] = None
         elif not role.get("writable", True):
-            role["term"] = max(role.get("term", 0), 1) + 1
+            role["term"] = max(
+                max(role.get("term", 0), 1) + 1, term or 0
+            )
         role["writable"] = True
+        role["suspended"] = False
+        if loss is not None:
+            role["loss_window"] = loss
         return {
             "promoted": True,
             "term": role["term"],
@@ -407,6 +617,7 @@ def promote_role(role: dict) -> dict:
             # False = the last poll before the primary vanished still had
             # records in flight: acknowledged-but-unshipped writes are lost
             "caught_up": caught_up,
+            "loss_window": loss,
         }
 
 
@@ -467,6 +678,18 @@ class RemoteStore(DocumentStore):
             0, int(os.environ.get("LO_CHUNK_RETRIES", "2"))
         )
         self._local = threading.local()
+        # collection → monotonic time of the last AMBIGUOUS write
+        # failure (connection death / timeout / 5xx mid-request) this
+        # client saw against it. A later duplicate-id 409 on an
+        # explicit-id write to a marked collection is verified by
+        # reading the rows back: a higher-level retry (the scheduler
+        # re-running an ingest op after a failover window) replays
+        # writes that DID land, and used to abort a fully durable
+        # ingest with a KeyError (ADVICE r5).
+        self._ambiguous_marks: dict[str, float] = {}
+        self.landed_ok_window_s = float(
+            os.environ.get("LO_LANDED_OK_WINDOW_S", "600")
+        )
         # Lazily-built read-ahead pool: chunk N+1's network fetch
         # overlaps chunk N's decode (+ inflate). Per-STORE and
         # persistent so the helper threads' requests.Sessions survive
@@ -511,14 +734,75 @@ class RemoteStore(DocumentStore):
                 raise UnsupportedQueryError(payload.get("error", "bad query"))
             raise ValueError(payload.get("error", "bad request"))
         if response.status_code == 503:
-            raise PermissionError(
+            raise StoreUnavailableError(
                 response.json().get("error", "read-only follower")
             )
         response.raise_for_status()
 
-    def _send(self, send, retry: bool = True, landed_ok: bool = False):
+    def _mark_ambiguous(self, collection: Optional[str]) -> None:
+        """Remember that a write against ``collection`` failed
+        ambiguously — it may have landed. A later 409 on an explicit-id
+        write to the collection (within ``LO_LANDED_OK_WINDOW_S``) is
+        then verified by read instead of raised as a duplicate."""
+        if collection:
+            import time
+
+            self._ambiguous_marks[collection] = time.monotonic()
+
+    def _recently_ambiguous(self, collection: Optional[str]) -> bool:
+        if not collection:
+            return False
+        import time
+
+        marked = self._ambiguous_marks.get(collection)
+        return (
+            marked is not None
+            and time.monotonic() - marked <= self.landed_ok_window_s
+        )
+
+    @staticmethod
+    def _as_http_error(response) -> Exception:
+        try:
+            response.raise_for_status()
+        except requests.HTTPError as error:
+            return error
+        return requests.HTTPError(
+            f"unexpected status {response.status_code}", response=response
+        )
+
+    def _finish(
+        self, response, ambiguous, landed_ok, collection, verify
+    ):
+        if response.status_code == 409 and landed_ok:
+            if ambiguous:
+                # the ids we just re-sent are already present: the
+                # pre-failover attempt landed — success
+                return response
+            if (
+                self._recently_ambiguous(collection)
+                and verify is not None
+                and verify()
+            ):
+                # a higher-level retry (scheduler re-running the op
+                # after an earlier ambiguous failure) replayed a write
+                # that DID land: the stored rows match what we just
+                # sent byte for byte, so this is idempotent success,
+                # not a duplicate (ADVICE r5)
+                return response
+        self._raise_for(response)
+        return response
+
+    def _send(
+        self,
+        send,
+        retry: bool = True,
+        landed_ok: bool = False,
+        collection: Optional[str] = None,
+        verify=None,
+    ):
         """Issue ``send(base_url)``, re-pointing at the writable peer on
-        connection failure or a follower's 503.
+        connection failure, a follower's/suspended primary's 503, or an
+        ambiguous 5xx.
 
         ``retry=False`` marks non-idempotent calls (inserts whose ids
         the SERVER assigns): replaying one after a mid-write primary
@@ -530,32 +814,67 @@ class RemoteStore(DocumentStore):
 
         ``landed_ok=True`` marks explicit-id writes, and means: a
         duplicate-id 409 on an attempt that FOLLOWS an ambiguous
-        failure (connection death / timeout mid-request) is the write
-        we just sent having already landed before the old primary died
-        — treat it as success instead of raising ``KeyError``, so a
-        long chunked ingest survives a failover mid-batch. A 409 on a
-        clean first attempt is a genuine duplicate and still raises."""
+        failure (connection death / timeout / 5xx mid-request) is the
+        write we just sent having already landed before the old primary
+        died — treat it as success instead of raising ``KeyError``, so
+        a long chunked ingest survives a failover mid-batch. A 409 on a
+        clean first attempt is a genuine duplicate and still raises —
+        UNLESS this client recently saw an ambiguous failure on the
+        same ``collection`` and ``verify()`` confirms the stored rows
+        equal what was just sent (the cross-call replay of a landed
+        write, e.g. the scheduler retrying a whole ingest op)."""
         import time
 
         ambiguous = False  # a send died mid-request: it may have landed
+        last_error: Optional[Exception] = None
+        # 5xx RESPONSES get a small retry budget, not the whole failover
+        # window: a handler that 500s deterministically (a bug, not a
+        # dying server) must fail in a few attempts instead of hammering
+        # every replica for LO_FAILOVER_TIMEOUT_S. Connection-level
+        # failures keep the full window — those mean a server is gone
+        # and riding out the takeover is the point.
+        server_error_budget = max(2, self.chunk_retries)
+        server_errors = 0
         try:
             response = send(self.base_url)
-            # a 503 is a CLEAN rejection (nothing was applied), so even
-            # non-retryable auto-id inserts may safely re-point and
-            # retry — the retry flag only guards AMBIGUOUS failures
-            if response.status_code != 503 or len(self.urls) == 1:
-                self._raise_for(response)
-                return response
-            last_error: Optional[Exception] = None
         # Timeout included: a partitioned/hung primary raises ReadTimeout
         # (not a ConnectionError subclass) and must also re-point —
         # explicit-id retries stay safe either way (duplicate-id 409 if
-        # the write had landed, swallowed below under landed_ok)
+        # the write had landed, swallowed under landed_ok)
         except (requests.ConnectionError, requests.Timeout) as error:
+            if landed_ok:
+                self._mark_ambiguous(collection)
             if len(self.urls) == 1 or not retry:
                 raise
             ambiguous = True
             last_error = error
+        else:
+            failed_5xx = (
+                response.status_code >= 500 and response.status_code != 503
+            )
+            if failed_5xx and landed_ok:
+                # a 5xx mid-request is as ambiguous as a dropped
+                # connection: the handler may have applied before dying.
+                # Marked on EVERY such response — single-URL clients
+                # included — so a scheduler-level replay of the op can
+                # verify its clean-attempt 409 instead of aborting a
+                # durable ingest (the connection-death path above marks
+                # before raising for the same reason).
+                self._mark_ambiguous(collection)
+            if response.status_code == 503 and len(self.urls) > 1:
+                # a 503 is a CLEAN rejection (nothing was applied), so
+                # even non-retryable auto-id inserts may safely re-point
+                # and retry — the retry flag only guards AMBIGUOUS
+                # failures
+                pass
+            elif failed_5xx and retry and len(self.urls) > 1:
+                ambiguous = True
+                server_errors = 1
+                last_error = self._as_http_error(response)
+            else:
+                return self._finish(
+                    response, False, landed_ok, collection, verify
+                )
         deadline = time.monotonic() + self.failover_timeout
         while True:
             alive = []
@@ -569,6 +888,8 @@ class RemoteStore(DocumentStore):
                 try:
                     response = send(url)
                 except (requests.ConnectionError, requests.Timeout) as error:
+                    if landed_ok:
+                        self._mark_ambiguous(collection)
                     if not retry:
                         # entered via a clean 503, but THIS attempt died
                         # ambiguously mid-request: a non-idempotent call
@@ -577,22 +898,25 @@ class RemoteStore(DocumentStore):
                     ambiguous = True
                     last_error = error
                     continue  # just died too; try the next
-                if response.status_code != 503:
-                    self.base_url = url
-                    if (
-                        ambiguous
-                        and landed_ok
-                        and response.status_code == 409
-                    ):
-                        # the ids we just re-sent are already present:
-                        # the pre-failover attempt landed — success
-                        return response
-                    self._raise_for(response)
-                    return response
+                if response.status_code == 503:
+                    continue
+                if response.status_code >= 500 and retry:
+                    if landed_ok:
+                        self._mark_ambiguous(collection)
+                    ambiguous = True
+                    server_errors += 1
+                    last_error = self._as_http_error(response)
+                    if server_errors > server_error_budget:
+                        raise last_error
+                    continue
+                self.base_url = url
+                return self._finish(
+                    response, ambiguous, landed_ok, collection, verify
+                )
             if time.monotonic() > deadline:
                 if last_error is not None:
                     raise last_error
-                raise PermissionError(
+                raise StoreUnavailableError(
                     "no writable store server among "
                     + ",".join(self.urls)
                 )
@@ -604,6 +928,8 @@ class RemoteStore(DocumentStore):
         body: dict,
         retry: bool = True,
         landed_ok: bool = False,
+        collection: Optional[str] = None,
+        verify=None,
     ) -> dict:
         data = json.dumps(body)
         return self._send(
@@ -615,10 +941,17 @@ class RemoteStore(DocumentStore):
             ),
             retry=retry,
             landed_ok=landed_ok,
+            collection=collection,
+            verify=verify,
         ).json()
 
     def _post_frame(
-        self, path: str, frame: bytes, landed_ok: bool = False
+        self,
+        path: str,
+        frame: bytes,
+        landed_ok: bool = False,
+        collection: Optional[str] = None,
+        verify=None,
     ) -> dict:
         headers = {"Content-Type": BIN_CONTENT_TYPE}
         if self.compress and len(frame) >= COMPRESS_MIN_BYTES:
@@ -632,7 +965,27 @@ class RemoteStore(DocumentStore):
                 timeout=self.timeout,
             ),
             landed_ok=landed_ok,
+            collection=collection,
+            verify=verify,
         ).json()
+
+    def _documents_landed(
+        self, collection: str, documents: list[dict]
+    ) -> bool:
+        """True when every sent document is stored with equal content —
+        the read-back verification behind the cross-call landed-ok path
+        (a genuine duplicate with DIFFERENT content still raises)."""
+        try:
+            for sent in documents:
+                stored = self.find_one(collection, {ROW_ID: sent[ROW_ID]})
+                if stored is None:
+                    return False
+                for key, value in sent.items():
+                    if not _values_match(stored.get(key), value):
+                        return False
+            return True
+        except Exception:
+            return False  # verification must never mask the original 409
 
     def _fetch_frame_bytes(self, path: str, body: dict) -> bytes:
         """POST JSON, receive raw frame bytes (wire compression undone).
@@ -688,11 +1041,18 @@ class RemoteStore(DocumentStore):
         # retry across failover only with an explicit _id: a replayed
         # auto-id insert would duplicate the row instead of raising the
         # duplicate-id KeyError that makes explicit-id retries safe
+        explicit = "_id" in document
         self._post(
             f"/c/{collection}/insert_one",
             {"document": document},
-            retry="_id" in document,
-            landed_ok="_id" in document,
+            retry=explicit,
+            landed_ok=explicit,
+            collection=collection,
+            verify=(
+                (lambda: self._documents_landed(collection, [document]))
+                if explicit
+                else None
+            ),
         )
 
     def insert_many(self, collection: str, documents: list[dict]) -> None:
@@ -702,6 +1062,12 @@ class RemoteStore(DocumentStore):
             {"documents": documents},
             retry=explicit,
             landed_ok=explicit,
+            collection=collection,
+            verify=(
+                (lambda: self._documents_landed(collection, documents))
+                if explicit
+                else None
+            ),
         )
 
     def insert_columns(
@@ -743,15 +1109,43 @@ class RemoteStore(DocumentStore):
             extra = {
                 "start_id": None if start_id is None else start_id + offset
             }
+            verify = None
+            if start_id is not None and stop > offset:
+                chunk_start_id = start_id + offset
+                endpoints = [
+                    self._chunk_row(chunk, 0, chunk_start_id),
+                    self._chunk_row(
+                        chunk, stop - offset - 1, start_id + stop - 1
+                    ),
+                ]
+                # block appends are atomic server-side, so matching
+                # endpoint rows prove the whole chunk landed
+                verify = lambda docs=endpoints: self._documents_landed(  # noqa: E731
+                    collection, docs
+                )
             self._post_frame(
                 f"/c/{collection}/insert_columns_bin",
                 encode_frame(chunk, extra=extra),
                 # chunks at an explicit start_id: a duplicate rejection
                 # on the post-failover replay means the chunk landed
                 landed_ok=start_id is not None,
+                collection=collection,
+                verify=verify,
             )
             if stop >= num_rows:
                 break
+
+    @staticmethod
+    def _chunk_row(chunk: dict[str, Column], index: int, doc_id) -> dict:
+        """Synthesize the document a chunk row will be stored as."""
+        from learningorchestra_tpu.core.columns import MISSING
+
+        document = {ROW_ID: doc_id}
+        for name, column in chunk.items():
+            value = column.get(index)
+            if value is not MISSING:
+                document[name] = value
+        return document
 
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         self._post(
@@ -913,6 +1307,32 @@ class RemoteStore(DocumentStore):
 
                 time.sleep(min(0.2 * attempt, 1.0))
 
+    def _decode_chunk(
+        self, collection: str, fields, chunk_start: int, chunk_limit: int, raw: bytes
+    ):
+        """Decode one chunk's frame, re-fetching THIS chunk in place on
+        a corrupt frame — a torn/truncated body that slipped past HTTP
+        framing (a server falling over mid-response). Same budget and
+        cache hygiene as the transport-level chunk retries: the
+        partially-filled device-cache entry is purged, and earlier
+        chunks' decoded bytes are kept."""
+        import struct
+
+        attempt = 0
+        while True:
+            try:
+                return decode_frame(raw)
+            except (ValueError, KeyError, IndexError, struct.error):
+                from learningorchestra_tpu.core import devcache
+
+                devcache.invalidate_collection(collection, store=self)
+                if attempt >= self.chunk_retries:
+                    raise
+                attempt += 1
+                raw = self._fetch_chunk(
+                    collection, fields, chunk_start, chunk_limit
+                )
+
     def _read_column_arrays_once(
         self,
         collection: str,
@@ -977,7 +1397,9 @@ class RemoteStore(DocumentStore):
                         next_start,
                         next_limit,
                     )
-                columns, extra = decode_frame(raw)
+                columns, extra = self._decode_chunk(
+                    collection, fields, chunk_start, chunk_limit, raw
+                )
                 chunk_rev = extra.get("rev", -1)
                 if rev is None:
                     rev = chunk_rev
@@ -1057,21 +1479,34 @@ class ReplicationClient:
         self,
         store: InMemoryStore,
         primary_url: str,
-        interval: float = 0.5,
+        interval: Optional[float] = None,
         batch: int = 10000,
+        node_id: Optional[str] = None,
     ):
         self.store = store
         self.primary_url = primary_url.rstrip("/")
-        self.interval = interval
+        self.interval = (
+            interval
+            if interval is not None
+            else float(os.environ.get("LO_REPL_INTERVAL_S", "0.5"))
+        )
         self.batch = batch
+        # identifies this node at the store.net fault point so chaos
+        # tests can partition ONE side's server-to-server traffic
+        self.node_id = node_id
         self.epoch = -1
         self.offset = 0
         # Takeover bookkeeping: the primary's term (from the /wal feed),
-        # whether the last successful poll had drained the feed, and how
-        # long the primary has been continuously unreachable (None =
-        # healthy) — what auto-promotion and the promote response report.
+        # whether the last successful poll had drained the feed, the
+        # primary's total feed length (loss-window accounting:
+        # primary_length - offset = acknowledged records not yet applied
+        # here), and how long the primary has been continuously
+        # unreachable (None = healthy) — what auto-promotion and the
+        # promote response report.
         self.primary_term = 0
         self.caught_up = False
+        self.primary_length = 0
+        self.last_poll_monotonic: Optional[float] = None
         self.failing_since: Optional[float] = None
         # A resync signal only marks intent; local state is replaced
         # atomically when the replacement records are actually in hand
@@ -1086,15 +1521,57 @@ class ReplicationClient:
         self._apply_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
-    def poll_once(self) -> int:
-        """One fetch+apply round; returns the number of records applied."""
+    @property
+    def lag(self) -> int:
+        """Acknowledged WAL records the primary holds that this
+        follower has not applied, as of the last successful poll —
+        exported as ``lo_store_replication_lag``."""
+        return max(0, self.primary_length - self.offset)
+
+    def loss_window(self) -> dict:
+        """What a takeover right now would cost (docs/replication.md):
+        records the primary acknowledged but never shipped, plus how
+        stale that measurement is. Writes the primary accepted AFTER
+        the last successful poll are unknowable from here — the window
+        is a floor, bounded above by ``last_poll_age_s`` of traffic."""
+        import time
+
+        age = (
+            None
+            if self.last_poll_monotonic is None
+            else round(time.monotonic() - self.last_poll_monotonic, 3)
+        )
+        return {
+            "records": self.lag,
+            "primary_wal_length": self.primary_length,
+            "applied_offset": self.offset,
+            "applied_epoch": self.epoch,
+            "last_poll_age_s": age,
+        }
+
+    def poll_once(self, wait: bool = False) -> int:
+        """One fetch+apply round; returns the number of records
+        applied. ``wait=True`` (the background loop) long-polls the
+        primary: a caught-up feed parks server-side until a record
+        lands, so replication — and with it sync-repl write acks —
+        reacts in tens of milliseconds instead of a poll interval.
+        Hand-driven pollers (tests, operators) default to the
+        immediate answer."""
+        import time
+
+        faults.fire(
+            "store.net", me=self.node_id, url=self.primary_url, kind="wal"
+        )
+        params = {
+            "epoch": self.epoch,
+            "offset": self.offset,
+            "limit": self.batch,
+        }
+        if wait:
+            params["wait"] = round(min(max(self.interval, 0.1), 25.0), 3)
         response = requests.get(
             f"{self.primary_url}/wal",
-            params={
-                "epoch": self.epoch,
-                "offset": self.offset,
-                "limit": self.batch,
-            },
+            params=params,
             timeout=60,
         )
         response.raise_for_status()
@@ -1104,6 +1581,8 @@ class ReplicationClient:
                 return 0
             self.primary_term = max(self.primary_term, feed.get("term", 0))
             self.caught_up = len(feed["records"]) < self.batch
+            self.primary_length = feed.get("length", feed.get("next", 0))
+            self.last_poll_monotonic = time.monotonic()
             if feed["resync"]:
                 self.epoch = feed["epoch"]
                 self.offset = 0
@@ -1130,8 +1609,9 @@ class ReplicationClient:
         import time
 
         while not self._stop.is_set():
+            started = time.monotonic()
             try:
-                applied = self.poll_once()
+                applied = self.poll_once(wait=True)
                 self.last_error = None
                 self.failing_since = None
             except Exception as error:  # primary down: keep serving reads
@@ -1139,7 +1619,13 @@ class ReplicationClient:
                 if self.failing_since is None:
                     self.failing_since = time.monotonic()
                 applied = 0
-            if applied == 0:
+            if applied == 0 and time.monotonic() - started < self.interval:
+                # only sleep when the empty answer came back FAST: a
+                # primary honoring the long-poll already waited the
+                # interval server-side (sleeping again would re-add the
+                # ack latency the long-poll removes); a dead primary or
+                # an old one ignoring `wait` returns/fails immediately
+                # and must not be hammered
                 self._stop.wait(self.interval)
 
     def start(self) -> "ReplicationClient":
@@ -1159,14 +1645,56 @@ class ReplicationClient:
             self._thread.join(timeout=10)
 
 
-def probe_health(url: str, timeout: float = 2.0) -> Optional[dict]:
-    """``/health`` of a peer store, or None when unreachable."""
+def probe_health(
+    url: str, timeout: float = 2.0, origin: Optional[str] = None
+) -> Optional[dict]:
+    """``/health`` of a peer store, or None when unreachable.
+    ``origin`` identifies a SERVER-side caller (monitor, fence) at the
+    ``store.net`` fault point so chaos tests can partition one node's
+    backend traffic; client-side probes pass no origin and stay
+    unaffected — a backend partition does not sever client reach."""
     try:
+        if origin is not None:
+            faults.fire(
+                "store.net", me=origin, url=url.rstrip("/"), kind="health"
+            )
         response = requests.get(f"{url.rstrip('/')}/health", timeout=timeout)
         response.raise_for_status()
         return response.json()
     except Exception:
         return None
+
+
+def request_votes(
+    voters: list[str],
+    term: int,
+    candidate: str,
+    origin: Optional[str] = None,
+    timeout: float = 2.0,
+) -> tuple[int, list[dict]]:
+    """Campaign for ``term``: POST /vote to every voter (store peers +
+    arbiters). Returns ``(granted_including_self, responses)`` — the
+    candidate's own vote is counted here, the caller must have recorded
+    it in its ledger first (one vote per term applies to self too)."""
+    granted = 1  # self
+    responses: list[dict] = []
+    for voter in voters:
+        url = voter.rstrip("/")
+        try:
+            if origin is not None:
+                faults.fire("store.net", me=origin, url=url, kind="vote")
+            response = requests.post(
+                f"{url}/vote",
+                json={"term": term, "candidate": candidate},
+                timeout=timeout,
+            )
+            payload = response.json()
+        except Exception:
+            continue
+        responses.append(payload)
+        if payload.get("granted"):
+            granted += 1
+    return granted, responses
 
 
 def serve(
@@ -1177,6 +1705,12 @@ def serve(
     primary_url: Optional[str] = None,
     peers: Optional[list[str]] = None,
     auto_promote_s: Optional[float] = None,
+    arbiters: Optional[list[str]] = None,
+    node_id: Optional[str] = None,
+    monitor_tick_s: Optional[float] = None,
+    quorum_grace_s: Optional[float] = None,
+    sync_repl: Optional[bool] = None,
+    ack_timeout_s: Optional[float] = None,
 ) -> ServerThread:
     """Start a store server thread; returns it (caller stops).
 
@@ -1193,9 +1727,18 @@ def serve(
     primary); while running, a writable server demotes itself only to
     a writable peer with a strictly higher term. ``auto_promote_s``
     (LO_AUTO_PROMOTE_S) makes a follower promote itself once its
-    primary has been unreachable for that long — the election analogue
-    (reference docker-compose.yml:49-91) minus the quorum, documented
-    in the module docstring.
+    primary has been unreachable for that long.
+
+    ``arbiters`` (LO_ARBITERS) switches failover to QUORUM mode
+    (docs/replication.md): auto-promotion requires a majority of votes
+    from the voting population (this server + peers + arbiters), and a
+    writable server that cannot reach a majority of voters for
+    ``quorum_grace_s`` (LO_QUORUM_GRACE_S) suspends writes — 503 +
+    Retry-After, reads keep serving — until quorum returns and no
+    superseding primary is visible. ``sync_repl``
+    (LO_STORE_SYNC_REPL=1) withholds mutation acks until a follower's
+    WAL cursor passes them (bounded by ``ack_timeout_s`` /
+    LO_STORE_ACK_TIMEOUT_S) — the zero-lost-acknowledged-writes mode.
     """
     import time
 
@@ -1203,6 +1746,7 @@ def serve(
         data_dir=data_dir,
         replicate=replicate or primary_url is not None or bool(peers),
     )
+    arbiters = [a.rstrip("/") for a in (arbiters or []) if a]
     writable = primary_url is None
     if writable and peers:
         # Startup fence: a server coming up writable must make sure no
@@ -1210,13 +1754,17 @@ def serve(
         # old primary of a same-term promote race; a genuinely fresh
         # pair starts follower-less, so no peer answers writable).
         for peer in peers:
-            health = probe_health(peer)
+            health = probe_health(peer, origin=node_id)
             if health and health.get("writable"):
                 writable = False
                 primary_url = peer
                 break
     import secrets
 
+    if sync_repl is None:
+        sync_repl = os.environ.get("LO_STORE_SYNC_REPL", "0") == "1"
+    if ack_timeout_s is None:
+        ack_timeout_s = float(os.environ.get("LO_STORE_ACK_TIMEOUT_S", "2.0"))
     role = {
         "writable": writable,
         "poller": None,
@@ -1226,9 +1774,14 @@ def serve(
         # probe saw the other) deterministically converge on the higher
         # boot id instead of split-braining at term 1 == term 1
         "boot": secrets.token_hex(8),
+        "sync_repl": bool(sync_repl),
+        "ack_timeout_s": ack_timeout_s,
     }
+    me = node_id or role["boot"]
     if primary_url is not None and not writable:
-        role["poller"] = ReplicationClient(store, primary_url).start()
+        role["poller"] = ReplicationClient(
+            store, primary_url, node_id=me
+        ).start()
     server = ServerThread(create_store_app(store, role), host, port).start()
     server.store = store
     server.store_role = role
@@ -1242,16 +1795,130 @@ def serve(
             if not role.get("writable"):
                 return
             role["writable"] = False
-            role["poller"] = ReplicationClient(store, peer).start()
+            role["suspended"] = False
+            role["poller"] = ReplicationClient(
+                store, peer, node_id=me
+            ).start()
             server.replication = role["poller"]
         print(f"store: fenced — rejoining as follower of {peer}", flush=True)
 
-    if peers or auto_promote_s:
+    def refollow(peer: str) -> None:
+        """A follower whose primary pointer went stale (its primary
+        died and a QUORUM election elsewhere produced a new one)
+        re-points its WAL poller at the visible writable peer."""
+        with role["lock"]:
+            if role.get("writable"):
+                return
+            poller = role.get("poller")
+            if (
+                poller is not None
+                and poller.primary_url == peer.rstrip("/")
+            ):
+                return
+            if poller is not None:
+                poller.stop()
+            role["poller"] = ReplicationClient(
+                store, peer, node_id=me
+            ).start()
+            server.replication = role["poller"]
+        print(f"store: re-following new primary {peer}", flush=True)
+
+    quorum = bool(arbiters)
+    voters = list(peers or []) + arbiters
+    population = 1 + len(voters)
+    tick = (
+        monitor_tick_s
+        if monitor_tick_s is not None
+        else float(os.environ.get("LO_STORE_MONITOR_TICK_S", "1.0"))
+    )
+    if quorum_grace_s is None:
+        grace_env = os.environ.get("LO_QUORUM_GRACE_S")
+        if grace_env:
+            quorum_grace_s = float(grace_env)
+        else:
+            # a primary must suspend BEFORE the majority side can have
+            # promoted, or a short dual-primary window opens: default
+            # the grace under the takeover timer
+            quorum_grace_s = (
+                min(2.0, auto_promote_s / 2) if auto_promote_s else 2.0
+            )
+
+    if peers or auto_promote_s or arbiters:
         monitor_stop = threading.Event()
+
+        def try_takeover(poller) -> None:
+            """The follower's promotion decision, quorum-gated when
+            arbiters are configured."""
+            if not quorum:
+                result = promote_role(role)
+                server.replication = None
+                print(
+                    "store: primary gone/unwritable for "
+                    f"{auto_promote_s:g}s — self-promoted "
+                    f"(term {result['term']}, caught_up="
+                    f"{result['caught_up']})",
+                    flush=True,
+                )
+                return
+            # the primary may not be GONE — a completed election
+            # elsewhere means refollow the winner, not campaign
+            for peer in peers or []:
+                health = probe_health(peer, origin=me)
+                if (
+                    health
+                    and health.get("writable")
+                    and not health.get("suspended")
+                ):
+                    refollow(peer)
+                    return
+            with role["lock"]:
+                if role.get("writable"):
+                    return
+                # candidate term AND the self-vote ledger write happen
+                # under ONE lock acquisition: computing the term outside
+                # would race a concurrent POST /vote granting a higher
+                # term, and overwriting voted_term downward would let
+                # this node vote twice in that term (two majorities)
+                candidate_term = (
+                    max(
+                        role.get("term", 0),
+                        poller.primary_term,
+                        role.get("voted_term", 0),
+                        1,
+                    )
+                    + 1
+                )
+                role["voted_term"] = candidate_term
+                role["voted_for"] = me
+            granted, _ = request_votes(
+                voters, candidate_term, me, origin=me
+            )
+            if granted * 2 > population:
+                result = promote_role(role, term=candidate_term)
+                server.replication = None
+                print(
+                    f"store: quorum takeover ({granted}/{population} "
+                    f"votes) — promoted (term {result['term']}, "
+                    f"caught_up={result['caught_up']}, "
+                    f"loss_window={result['loss_window']})",
+                    flush=True,
+                )
+            else:
+                counters["denied"] += 1
+                if counters["denied"] % 10 == 1:
+                    print(
+                        f"store: promotion blocked — {granted} of "
+                        f"{population} votes; staying a read-only "
+                        "follower",
+                        flush=True,
+                    )
+
+        counters = {"denied": 0}
 
         def monitor():
             unwritable_since: Optional[float] = None
-            while not monitor_stop.wait(1.0):
+            no_quorum_since: Optional[float] = None
+            while not monitor_stop.wait(tick):
                 poller = role.get("poller")
                 if auto_promote_s and poller is not None:
                     # A reachable-but-UNWRITABLE primary counts as down
@@ -1262,7 +1929,7 @@ def serve(
                     # sides then self-promote and the term/boot fence
                     # converges on one writer within a few ticks.
                     if poller.failing_since is None:
-                        health = probe_health(poller.primary_url)
+                        health = probe_health(poller.primary_url, origin=me)
                         if health is not None and not health.get("writable"):
                             if unwritable_since is None:
                                 unwritable_since = time.monotonic()
@@ -1277,21 +1944,97 @@ def serve(
                         down_since is not None
                         and time.monotonic() - down_since >= auto_promote_s
                     ):
-                        result = promote_role(role)
-                        server.replication = None
-                        unwritable_since = None
+                        try_takeover(poller)
+                        if role.get("writable"):
+                            unwritable_since = None
+                peer_healths: dict[str, Optional[dict]] = {}
+                if role.get("writable"):
+                    for peer in peers or []:
+                        peer_healths[peer] = probe_health(peer, origin=me)
+                if quorum and role.get("writable"):
+                    # quorum custody: a primary that cannot reach a
+                    # majority of voters suspends writes (the minority
+                    # side of a partition degrades to read-only instead
+                    # of diverging); resumes only once quorum is back
+                    # AND no superseding primary is visible
+                    reachable = 1
+                    superior = False
+                    my_term = role.get("term", 0)
+                    my_boot = role.get("boot", "")
+                    for voter in voters:
+                        health = (
+                            peer_healths[voter]
+                            if voter in peer_healths
+                            else probe_health(voter, origin=me)
+                        )
+                        if not health:
+                            continue
+                        reachable += 1
+                        # ANY voter reporting a higher term — the
+                        # arbiter included (its /health carries the
+                        # highest term it has voted) — is proof an
+                        # election superseded this primary. Counting
+                        # only writable peers here would let an
+                        # asymmetric partition (primary↔follower link
+                        # down, both still reach the arbiter) keep TWO
+                        # writers: the follower wins self+arbiter, the
+                        # old primary still counts quorum via the
+                        # arbiter and never hears about the new term.
+                        peer_term = max(
+                            health.get("term", 0),
+                            health.get("voted_term", 0),
+                        )
+                        if peer_term > my_term or (
+                            health.get("writable")
+                            and peer_term == my_term
+                            and health.get("boot", "") > my_boot
+                        ):
+                            superior = True
+                    if superior and not role.get("suspended"):
+                        # definitive supersession evidence: suspend NOW
+                        # (no grace — the other side may already be
+                        # accepting writes); the fence below demotes to
+                        # the new primary once it becomes visible
+                        with role["lock"]:
+                            role["suspended"] = True
                         print(
-                            "store: primary gone/unwritable for "
-                            f"{auto_promote_s:g}s — self-promoted "
-                            f"(term {result['term']}, caught_up="
-                            f"{result['caught_up']})",
+                            "store: a voter reports a higher term — "
+                            "superseded; suspending writes until the "
+                            "new primary is visible",
                             flush=True,
                         )
+                    if reachable * 2 <= population:
+                        if no_quorum_since is None:
+                            no_quorum_since = time.monotonic()
+                        if (
+                            time.monotonic() - no_quorum_since
+                            >= quorum_grace_s
+                            and not role.get("suspended")
+                        ):
+                            with role["lock"]:
+                                role["suspended"] = True
+                            print(
+                                "store: quorum lost "
+                                f"({reachable}/{population} voters "
+                                "reachable) — suspending writes, reads "
+                                "keep serving",
+                                flush=True,
+                            )
+                    else:
+                        no_quorum_since = None
+                        if role.get("suspended") and not superior:
+                            with role["lock"]:
+                                role["suspended"] = False
+                            print(
+                                "store: quorum restored — resuming "
+                                "writes",
+                                flush=True,
+                            )
                 if peers and role.get("writable"):
                     my_term = role.get("term", 0)
                     my_boot = role.get("boot", "")
                     for peer in peers:
-                        health = probe_health(peer)
+                        health = peer_healths.get(peer)
                         if not health or not health.get("writable"):
                             continue
                         peer_term = health.get("term", 0)
@@ -1342,6 +2085,11 @@ def serve(
 
 
 def main() -> None:
+    try:
+        # a typo'd chaos knob must refuse bring-up, not silently not fire
+        faults.validate_env()
+    except ValueError as error:
+        raise SystemExit(f"LO_FAULT_* validation failed: {error}")
     host = os.environ.get("LO_HOST", "127.0.0.1")
     port = int(os.environ.get("LO_STORE_PORT", DEFAULT_STORE_PORT))
     data_dir = os.environ.get("LO_DATA_DIR")
@@ -1349,16 +2097,30 @@ def main() -> None:
     primary_url = os.environ.get("LO_PRIMARY_URL")
     peers_env = os.environ.get("LO_PEERS", "")
     peers = [p.strip() for p in peers_env.split(",") if p.strip()] or None
+    arbiters_env = os.environ.get("LO_ARBITERS", "")
+    arbiters = [
+        a.strip() for a in arbiters_env.split(",") if a.strip()
+    ] or None
     auto_env = os.environ.get("LO_AUTO_PROMOTE_S")
     auto_promote_s = float(auto_env) if auto_env else None
     server = serve(
-        host, port, data_dir, replicate, primary_url, peers, auto_promote_s
+        host,
+        port,
+        data_dir,
+        replicate,
+        primary_url,
+        peers,
+        auto_promote_s,
+        arbiters=arbiters,
+        node_id=os.environ.get("LO_NODE_ID"),
     )
     mode = (
         f"follower of {primary_url}"
         if primary_url
         else ("primary (replicating)" if replicate else "standalone")
     )
+    if arbiters:
+        mode += f", quorum via {len(arbiters)} arbiter(s)"
     print(
         f"store server on {host}:{server.port} (data_dir={data_dir}, {mode})",
         flush=True,

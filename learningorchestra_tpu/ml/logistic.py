@@ -251,6 +251,25 @@ _LR_CHECK_ITERS = 25
 _LR_TOL = 1e-6
 
 
+def _plateaued(history: list[float], tol: float, window: int) -> bool:
+    """True when the trailing ``window`` pre-step losses form a genuine
+    plateau: EVERY consecutive delta is under the (relative) tolerance
+    AND so is the total improvement across the window. A single
+    floor-step Armijo iteration (step clamped to 1/16, objective barely
+    moves once) produces one tiny delta inside an otherwise-descending
+    run and must NOT stop the fit (ADVICE r5); ``window - 1``
+    consecutive sub-tol deltas that also sum to nothing is a stall, not
+    noise."""
+    if len(history) < window:
+        return False
+    recent = history[-window:]
+    threshold = tol * max(abs(recent[-1]), 1.0)
+    return abs(recent[-1] - recent[0]) <= threshold and all(
+        abs(recent[i + 1] - recent[i]) <= threshold
+        for i in range(len(recent) - 1)
+    )
+
+
 def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
     """L-BFGS fit in watchdog-safe segments (see base.segment_steps),
     stopping once the objective's per-iteration improvement stays under
@@ -293,14 +312,8 @@ def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
         # array.
         history.extend(float(v) for v in np.asarray(segment_losses))
         del history[:-window]
-        if len(history) >= window:
-            last = history[-1]
-            threshold = tol * max(abs(last), 1.0)
-            if all(
-                abs(history[i + 1] - history[i]) <= threshold
-                for i in range(len(history) - 1)
-            ):
-                break
+        if _plateaued(history, tol, window):
+            break
     return params, (
         jnp.concatenate(losses) if len(losses) > 1 else losses[0]
     )

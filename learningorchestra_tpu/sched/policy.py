@@ -23,10 +23,13 @@ class TransientJobError(RuntimeError):
 
 
 # Exception type names (checked by name so this module never imports
-# jax: parallel/spmd.py defines SpmdTimeoutError but importing it pulls
-# the device runtime into every client process) that classify as
-# transient alongside TransientJobError subclasses.
-_TRANSIENT_TYPE_NAMES = frozenset({"SpmdTimeoutError"})
+# jax or the store client: parallel/spmd.py defines SpmdTimeoutError
+# and core/store_service.py defines StoreUnavailableError, but
+# importing either pulls heavy deps into every client process) that
+# classify as transient alongside TransientJobError subclasses.
+_TRANSIENT_TYPE_NAMES = frozenset(
+    {"SpmdTimeoutError", "StoreUnavailableError"}
+)
 
 
 def is_transient(error: BaseException) -> bool:
@@ -35,8 +38,14 @@ def is_transient(error: BaseException) -> bool:
     ``TransientJobError`` by contract; ``SpmdTimeoutError`` because the
     watchdog fires for worker-death *and* for overlong collectives —
     after the supervisor restarts the runtime the same job usually
-    succeeds, so the retry rides out the restart window. Its subclass
-    check is by type name to keep jax out of the import graph.
+    succeeds, so the retry rides out the restart window; the store
+    client's ``StoreUnavailableError`` because a 503 is the replicated
+    store's *transient* degraded state by contract — a read-only
+    follower mid-takeover or a quorum-suspended minority primary
+    answering 503 + Retry-After (docs/replication.md) — and the job
+    usually succeeds once failover completes or the partition heals.
+    Subclass checks are by type name to keep the heavy imports out of
+    the graph.
     """
     if isinstance(error, TransientJobError):
         return True

@@ -332,12 +332,25 @@ def _register_jitcache(registry: MetricsRegistry) -> None:
 _REGISTERED_STORES: "dict[int, str]" = {}
 
 
-def register_store(store: object, registry: Optional[MetricsRegistry] = None) -> None:
+def register_store(
+    store: object,
+    registry: Optional[MetricsRegistry] = None,
+    role: Optional[dict] = None,
+) -> None:
     """Expose a store's occupancy gauges (collection count, WAL bytes,
     spill bytes) on ``/metrics``, labelled by registration order.
     Idempotent per store instance; a store without ``telemetry_stats``
     (e.g. the remote-store client — the store SERVER scrapes its own)
-    is a no-op."""
+    is a no-op.
+
+    ``role`` (the store SERVER's HA role dict) additionally exports the
+    replication health the failover story is judged by
+    (docs/replication.md): ``lo_store_replication_lag`` (follower:
+    acknowledged records not yet applied locally),
+    ``lo_store_loss_window`` (what this server's last takeover
+    measurably cost, in records), and ``lo_store_unreplicated_acks``
+    (sync-repl mode: writes acknowledged after the replication wait
+    timed out)."""
     stats_fn = getattr(store, "telemetry_stats", None)
     if stats_fn is None:
         return
@@ -363,11 +376,37 @@ def register_store(store: object, registry: Optional[MetricsRegistry] = None) ->
         "Bytes of column payloads spilled to disk-backed mappings",
         labels=("store",),
     )
+    if role is not None:
+        replication_lag = registry.gauge(
+            "lo_store_replication_lag",
+            "Acknowledged WAL records this follower has not applied yet",
+            labels=("store",),
+        )
+        loss_window = registry.gauge(
+            "lo_store_loss_window",
+            "Records in the measured loss window of the last takeover",
+            labels=("store",),
+        )
+        unreplicated_acks = registry.gauge(
+            "lo_store_unreplicated_acks",
+            "Writes acknowledged after the sync-replication wait timed out",
+            labels=("store",),
+        )
 
     def collect(_registry: MetricsRegistry) -> None:
         stats = stats_fn()
         collections.labels(label).set(stats["collections"])
         wal_bytes.labels(label).set(stats["wal_bytes"])
         spill_bytes.labels(label).set(stats["spill_bytes"])
+        if role is not None:
+            poller = role.get("poller")
+            replication_lag.labels(label).set(
+                poller.lag if poller is not None else 0
+            )
+            loss = role.get("loss_window") or {}
+            loss_window.labels(label).set(loss.get("records", 0) or 0)
+            unreplicated_acks.labels(label).set(
+                role.get("unreplicated_acks", 0)
+            )
 
     registry.register_collector(collect)

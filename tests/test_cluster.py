@@ -340,6 +340,163 @@ class TestRemoteStop:
         assert calls == []
 
 
+class TestSshTransportLifecycle:
+    """The exact ssh commands the driver issues, exercised through a
+    PATH-shimmed fake `ssh` (ADVICE r5): the launch command must carry
+    the stdin-EOF watchdog knob (BatchMode allocates no pty, so a dead
+    driver can only signal the remote stack through its stdin), and
+    Machine.stop must issue the explicit remote pkill BEFORE a
+    whole-cluster relaunch so the old group never lingers holding the
+    store/coordinator ports."""
+
+    def _with_fake_ssh(self, tmp_path, monkeypatch):
+        ssh_log = tmp_path / "ssh_calls.log"
+        bin_dir = tmp_path / "bin"
+        bin_dir.mkdir()
+        fake_ssh = bin_dir / "ssh"
+        fake_ssh.write_text(
+            "#!/bin/sh\n"
+            f'printf \'%s\\n\' "$*" >> {ssh_log}\n'
+            "exec sleep 30\n"
+        )
+        fake_ssh.chmod(0o755)
+        monkeypatch.setenv(
+            "PATH", f"{bin_dir}{os.pathsep}{os.environ['PATH']}"
+        )
+        return ssh_log
+
+    def test_launch_and_stop_issue_the_documented_commands(
+        self, tmp_path, monkeypatch
+    ):
+        ssh_log = self._with_fake_ssh(tmp_path, monkeypatch)
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        plan = cluster.machine_plans(manifest)[1]  # the worker machine
+        machine = cluster.Machine(manifest, plan, log=lambda *_: None)
+        machine.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not ssh_log.exists():
+                time.sleep(0.05)
+            assert ssh_log.exists(), "fake ssh never invoked"
+            launch = ssh_log.read_text().splitlines()[0]
+            # the exact remote command: BatchMode, target, exec env,
+            # and the launcher-death watchdog armed
+            assert "-o BatchMode=yes" in launch
+            assert "user@10.0.0.2" in launch
+            assert "LO_STACK_EXIT_ON_STDIN_EOF=1" in launch
+            assert "deploy/stack.py" in launch
+        finally:
+            machine.stop()
+        calls = ssh_log.read_text().splitlines()
+        assert len(calls) >= 2, "stop issued no explicit remote kill"
+        kill = calls[-1]
+        assert "pkill -f deploy/stack.py" in kill
+        assert "user@10.0.0.2" in kill
+        # the supervised ssh client itself is gone too
+        assert machine.proc.poll() is not None
+
+    def test_local_transport_does_not_arm_watchdog(self):
+        cluster = _load_cluster_module()
+        manifest = _manifest(transport="local")
+        plans = cluster.machine_plans(manifest)
+        assert all(
+            "LO_STACK_EXIT_ON_STDIN_EOF" not in plan["env"]
+            for plan in plans
+        )
+
+
+class TestStackStdinWatchdog:
+    """deploy/stack.py's side of the contract: with the knob armed, EOF
+    on stdin (the ssh channel closing) triggers shutdown."""
+
+    def _load_stack(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "lo_deploy_stack",
+            os.path.join(_REPO_ROOT, "deploy", "stack.py"),
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    def test_eof_sets_stopping(self, monkeypatch):
+        import io
+        import threading
+
+        stack = self._load_stack()
+        monkeypatch.setenv("LO_STACK_EXIT_ON_STDIN_EOF", "1")
+        read_fd, write_fd = os.pipe()
+        stopping = threading.Event()
+        lines = []
+        thread = stack.start_stdin_watchdog(
+            stopping, lines.append, stream=io.open(read_fd, "rb")
+        )
+        assert thread is not None
+        assert not stopping.wait(0.2)  # channel open: keep running
+        os.close(write_fd)  # the launcher dies → EOF
+        assert stopping.wait(5), "EOF never triggered shutdown"
+        assert any("stdin closed" in line for line in lines)
+
+    def test_knob_off_means_no_watchdog(self, monkeypatch):
+        import threading
+
+        stack = self._load_stack()
+        monkeypatch.delenv("LO_STACK_EXIT_ON_STDIN_EOF", raising=False)
+        assert (
+            stack.start_stdin_watchdog(threading.Event(), print) is None
+        )
+
+
+class TestReplicationManifest:
+    def test_replication_section_plumbs_env_and_store_urls(self):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["replication"] = {
+            "enabled": True,
+            "follower_port": 27028,
+            "arbiter_port": 27029,
+            "auto_promote_s": 5,
+            "sync_repl": 1,
+        }
+        plans = cluster.machine_plans(manifest)
+        head_env = plans[0]["env"]
+        assert head_env["LO_REPLICATION"] == "1"
+        assert head_env["LO_FOLLOWER_PORT"] == "27028"
+        assert head_env["LO_ARBITER_PORT"] == "27029"
+        assert head_env["LO_AUTO_PROMOTE_S"] == "5"
+        assert head_env["LO_STORE_SYNC_REPL"] == "1"
+        # every worker's store URL names BOTH stores for client failover
+        worker_env = plans[1]["env"]
+        assert worker_env["LO_STORE_URL"] == (
+            "http://10.0.0.1:27027,http://10.0.0.1:27028"
+        )
+
+    def test_replication_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(replication):
+            manifest = _manifest()
+            manifest["replication"] = replication
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        loaded = load({"enabled": True})
+        assert loaded["replication"]["follower_port"] == 27028
+        with pytest.raises(SystemExit):
+            load({"enabled": True, "follower_port": 27027})  # collides
+        with pytest.raises(SystemExit):
+            load({"enabled": True, "auto_promote_s": 0})
+        with pytest.raises(SystemExit):
+            load({"enabled": "yes"})
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"enabled": True, "sync_repl": 2})
+
+
 class TestMetricsScrape:
     def test_parse_prometheus_sums_families(self):
         cluster = _load_cluster_module()

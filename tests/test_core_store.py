@@ -660,3 +660,81 @@ class TestSpillPolicy:
         store.create_collection("ram")
         store.insert_columns("ram", {"x": np.arange(rows, dtype=np.float64)})
         assert not store._collections["ram"].block_columns["x"].is_spilled()
+
+    def _spill_one(self, store, min_bytes, name="big", start_id=None):
+        import numpy as np
+
+        store.insert_columns(
+            name,
+            {"x": np.arange((min_bytes // 8) + 8, dtype=np.float64)},
+            start_id=start_id,
+        )
+
+    def _spill_files(self, root) -> list:
+        import os
+
+        return [
+            os.path.join(folder, f)
+            for folder, _, files in os.walk(root)
+            for f in files
+        ]
+
+    def test_resync_reclaims_spill_across_two_cycles(
+        self, monkeypatch, tmp_path
+    ):
+        """Every demotion/fence resync on a spilled follower must
+        reclaim the previous generation's spill files AND mappings —
+        repeated failovers under an explicit LO_SPILL_DIR must not grow
+        disk without bound (ADVICE r5)."""
+        import json as _json
+        import os
+
+        monkeypatch.setenv("LO_SPILL_BYTES", "1")
+        monkeypatch.setenv("LO_SPILL_DIR", str(tmp_path / "spill"))
+        from learningorchestra_tpu.core.store import (
+            _SPILL_MIN_COLUMN_BYTES,
+            InMemoryStore,
+        )
+
+        store = InMemoryStore(replicate=True)
+        spill_root = str(tmp_path / "spill")
+        resync_lines = [_json.dumps({"op": "create", "c": "fresh"})]
+        for cycle in range(2):
+            self._spill_one(store, _SPILL_MIN_COLUMN_BYTES)
+            assert self._spill_files(spill_root), "setup: nothing spilled"
+            assert store._spill_folders
+            store.resync_apply(resync_lines)
+            assert not self._spill_files(spill_root), (
+                f"resync cycle {cycle} stranded spill files"
+            )
+            assert not store._spill_folders, (
+                f"resync cycle {cycle} stranded folder mappings"
+            )
+            store.drop("fresh")  # reset for the next cycle
+        assert os.path.isdir(spill_root)  # the root itself is kept
+
+    def test_replicated_drop_reclaims_spill_files(
+        self, monkeypatch, tmp_path
+    ):
+        """A drop arriving over REPLICATION (apply_replicated →
+        _apply_record) must reclaim spill files exactly like a direct
+        drop() — a follower used to strand the folder and mis-route a
+        recreated same-name collection into the stale files."""
+        import json as _json
+
+        monkeypatch.setenv("LO_SPILL_BYTES", "1")
+        monkeypatch.setenv("LO_SPILL_DIR", str(tmp_path / "spill"))
+        from learningorchestra_tpu.core.store import (
+            _SPILL_MIN_COLUMN_BYTES,
+            InMemoryStore,
+        )
+
+        follower = InMemoryStore(replicate=True)
+        self._spill_one(follower, _SPILL_MIN_COLUMN_BYTES)
+        spill_root = str(tmp_path / "spill")
+        assert self._spill_files(spill_root)
+        follower.apply_replicated([_json.dumps({"op": "drop", "c": "big"})])
+        assert not self._spill_files(spill_root), (
+            "replicated drop stranded spill files"
+        )
+        assert "big" not in follower._spill_folders

@@ -1,0 +1,740 @@
+"""Chaos suite: the replicated store's failover invariants under
+injected faults (docs/replication.md).
+
+What is asserted end to end: a partition's minority primary suspends
+writes (503 + Retry-After) while reads keep serving and the majority
+follower wins a quorum election — no dual-primary instant; a takeover's
+loss window is measured and reported (promotion response, /health,
+/metrics); torn wire chunks are retried in place; WAL pollers always
+terminate; rev-keyed devcache entries never serve pre-failover content;
+and (slow, subprocess) a kill-primary-mid-ingest completes with zero
+lost acknowledged writes under sync replication."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from learningorchestra_tpu.core.arbiter import serve as serve_arbiter
+from learningorchestra_tpu.core.store import ROW_ID, InMemoryStore
+from learningorchestra_tpu.core.store_service import (
+    RemoteStore,
+    ReplicationClient,
+    StoreUnavailableError,
+    create_store_app,
+    serve,
+)
+from learningorchestra_tpu.testing import faults
+from learningorchestra_tpu.utils.web import ServerThread
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(predicate, timeout=15.0, message="condition", tick=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(tick)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+class TestFaultSpecs:
+    def test_spec_parsing_round_trips(self):
+        fault = faults.parse_spec("store.wal.feed", "delay:0.25@3")
+        assert fault.action == "delay" and fault.arg == 0.25
+        assert fault.count == 3
+        fault = faults.parse_spec("store.wire.mutate", "kill:5")
+        assert fault.action == "kill" and fault.arg == 5.0
+        fault = faults.parse_spec("store.wire.read_chunk", "torn")
+        assert fault.count == 1  # torn defaults to one corrupt chunk
+
+    @pytest.mark.parametrize(
+        "point,spec",
+        [
+            ("no.such.point", "error"),
+            ("store.net", "explode"),
+            ("store.net", "delay"),  # delay needs seconds
+            ("store.net", "delay:-1"),
+            ("store.net", "error@0"),
+            ("store.net", "kill:0"),
+            ("store.net", "kill@2"),  # kill takes :nth, not @n
+            ("store.net", "error:3"),  # error takes no ':' argument
+        ],
+    )
+    def test_malformed_specs_raise(self, point, spec):
+        with pytest.raises(ValueError):
+            faults.parse_spec(point, spec)
+
+    def test_validate_env_rejects_unknown_point(self):
+        with pytest.raises(ValueError, match="no such fault point"):
+            faults.validate_env({"LO_FAULT_STORE_WIRE_TYPO": "error"})
+        with pytest.raises(ValueError, match="unknown action"):
+            faults.validate_env({"LO_FAULT_STORE_NET": "explode"})
+        assert faults.validate_env(
+            {"LO_FAULT_STORE_NET": "error@2", "UNRELATED": "x"}
+        ) == {"store.net": "error@2"}
+
+    def test_error_budget_and_where_matching(self):
+        faults.install("store.net", "error@2", where={"me": "P"})
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("store.net", me="P", url="u")
+        faults.fire("store.net", me="F", url="u")  # other node unaffected
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("store.net", me="P", url="u")
+        faults.fire("store.net", me="P", url="u")  # budget spent
+
+    def test_torn_consumes_budget(self):
+        faults.install("store.wire.read_chunk", "torn@1")
+        assert faults.torn("store.wire.read_chunk") is True
+        assert faults.torn("store.wire.read_chunk") is False
+        assert faults.torn("store.wal.feed") is False  # other point
+
+    def test_invalid_env_disarms_instead_of_failing_every_hit(
+        self, monkeypatch, capsys
+    ):
+        """fire() runs inside production handlers: a typo'd knob that
+        slipped past the entry-point preflights must warn once and arm
+        nothing — never turn every mutation into an error."""
+        monkeypatch.setenv("LO_FAULT_STORE_WIRE_MUTTE", "kill:8")  # typo
+        faults.reset()
+        faults.fire("store.wire.mutate")  # must not raise
+        faults.fire("store.wire.mutate")
+        assert "ignoring invalid LO_FAULT_*" in capsys.readouterr().err
+
+
+class TestArbiterVotes:
+    def test_grant_is_idempotent_after_term_observation(self):
+        """A candidate whose grant response was lost retries the
+        identical request — the arbiter's observed-term bump must not
+        burn the vote the retry is reading back."""
+        from learningorchestra_tpu.core.arbiter import create_arbiter_app
+
+        state = {}
+        client = create_arbiter_app(state).test_client()
+        first = client.post("/vote", json={"term": 5, "candidate": "F"})
+        assert first.get_json()["granted"] is True
+        retry = client.post("/vote", json={"term": 5, "candidate": "F"})
+        assert retry.get_json()["granted"] is True  # idempotent re-ask
+        rival = client.post("/vote", json={"term": 5, "candidate": "X"})
+        assert rival.get_json()["granted"] is False  # one vote per term
+        stale = client.post("/vote", json={"term": 4, "candidate": "X"})
+        assert stale.get_json()["granted"] is False
+        newer = client.post("/vote", json={"term": 6, "candidate": "X"})
+        assert newer.get_json()["granted"] is True
+
+
+class TestTornChunk:
+    def test_torn_wire_frame_is_retried_in_place(self):
+        """A truncated binary frame (server falling over mid-response)
+        must not fail the read OR leave a torn result: the chunk is
+        re-fetched with the transport-retry budget."""
+        server = ServerThread(
+            create_store_app(InMemoryStore()), "127.0.0.1", 0
+        ).start()
+        try:
+            store = RemoteStore(f"http://127.0.0.1:{server.port}")
+            store.insert_columns(
+                "ds", {"a": list(range(100)), "b": [float(i) for i in range(100)]}
+            )
+            fault = faults.install("store.wire.read_chunk", "torn@1")
+            out = store.read_column_arrays("ds", ["a", "b"])
+            assert fault.hits >= 1, "the torn fault never fired"
+            assert out["a"].tolist() == list(range(100))
+            assert out["b"].tolist() == [float(i) for i in range(100)]
+        finally:
+            server.stop()
+
+    def test_torn_chunks_past_budget_surface(self):
+        server = ServerThread(
+            create_store_app(InMemoryStore()), "127.0.0.1", 0
+        ).start()
+        try:
+            store = RemoteStore(f"http://127.0.0.1:{server.port}")
+            store.chunk_retries = 1
+            store.insert_columns("ds", {"a": list(range(10))})
+            faults.install("store.wire.read_chunk", "torn@10")
+            with pytest.raises(Exception):
+                store.read_column_arrays("ds", ["a"])
+        finally:
+            server.stop()
+
+
+class TestWalLongPoll:
+    def test_long_poll_returns_early_when_a_record_lands(self):
+        """`GET /wal?wait=` parks a caught-up follower until a record
+        lands — the mechanism that keeps sync-repl ack latency at tens
+        of milliseconds instead of one poll interval per write."""
+        import threading
+
+        store = InMemoryStore(replicate=True)
+        server = ServerThread(
+            create_store_app(store), "127.0.0.1", 0
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            timer = threading.Timer(
+                0.3, lambda: store.insert_one("ds", {ROW_ID: 1})
+            )
+            timer.start()
+            started = time.monotonic()
+            feed = requests.get(
+                f"{url}/wal",
+                params={"epoch": 0, "offset": 0, "wait": 10},
+                timeout=30,
+            ).json()
+            elapsed = time.monotonic() - started
+            timer.cancel()
+            assert feed["records"], feed
+            assert 0.2 <= elapsed < 5, elapsed  # woke on the write
+        finally:
+            server.stop()
+
+    def test_store_voters_expose_voted_term(self):
+        """A store voter that granted a term must advertise it on
+        /health (like the arbiter): it is the supersession evidence a
+        quorum-holding-but-partitioned old primary relies on in
+        topologies with more than one follower."""
+        role = {"writable": False, "poller": None}
+        client = create_store_app(InMemoryStore(), role).test_client()
+        grant = client.post("/vote", json={"term": 7, "candidate": "B"})
+        assert grant.get_json()["granted"] is True
+        assert client.get("/health").get_json()["voted_term"] == 7
+
+    def test_wal_position_is_atomic_pairing(self):
+        store = InMemoryStore(replicate=True)
+        store.insert_one("ds", {ROW_ID: 1})
+        assert store.wal_position == (0, 1)
+        store.compact()
+        epoch, length = store.wal_position
+        assert epoch == 1 and length >= 1
+
+
+class TestLandedOkAfterServerError:
+    def test_single_url_500_after_apply_then_replay_succeeds(self):
+        """A handler that dies AFTER applying (500 back to a
+        single-URL client) is as ambiguous as a dropped connection:
+        the scheduler-level replay's clean 409 must verify by read and
+        succeed instead of aborting the durable ingest."""
+        server = ServerThread(
+            create_store_app(InMemoryStore()), "127.0.0.1", 0
+        ).start()
+        try:
+            store = RemoteStore(f"http://127.0.0.1:{server.port}")
+            faults.install("store.wire.mutate.applied", "error@1")
+            with pytest.raises(requests.HTTPError):
+                store.insert_one("ds", {ROW_ID: 1, "v": "x"})
+            # the write IS on the server; the replay must land as ok
+            store.insert_one("ds", {ROW_ID: 1, "v": "x"})
+            assert store.count("ds") == 1
+        finally:
+            server.stop()
+
+
+class TestQuorumPartition:
+    """The fast deterministic partition drill (default selection): the
+    minority primary suspends, the majority follower wins the election,
+    writes continue on the majority side, and healing demotes the old
+    primary — no dual-primary instant observed."""
+
+    def _topology(self):
+        p_port, f_port = _free_port(), _free_port()
+        p_url = f"http://127.0.0.1:{p_port}"
+        f_url = f"http://127.0.0.1:{f_port}"
+        arbiter = serve_arbiter("127.0.0.1", 0)
+        a_url = f"http://127.0.0.1:{arbiter.port}"
+        primary = serve(
+            "127.0.0.1",
+            p_port,
+            replicate=True,
+            peers=[f_url],
+            arbiters=[a_url],
+            node_id="P",
+            monitor_tick_s=0.1,
+            quorum_grace_s=0.3,
+        )
+        follower = serve(
+            "127.0.0.1",
+            f_port,
+            primary_url=p_url,
+            peers=[p_url],
+            arbiters=[a_url],
+            auto_promote_s=0.9,
+            node_id="F",
+            monitor_tick_s=0.1,
+        )
+        return arbiter, primary, follower, p_url, f_url, a_url
+
+    def test_partition_minority_suspends_majority_promotes(self):
+        arbiter, primary, follower, p_url, f_url, _ = self._topology()
+        try:
+            client = RemoteStore(p_url)
+            client.create_collection("ds")
+            client.insert_one("ds", {ROW_ID: 1, "v": "before"})
+            _wait_for(
+                lambda: follower.store.count("ds") == 1,
+                message="follower sync",
+            )
+
+            # partition the PRIMARY's backend traffic both ways: its
+            # own probes fail, and anything addressed to it from the
+            # backend (the follower's WAL polls, vote requests) fails.
+            # Client HTTP stays up — a backend partition does not sever
+            # client reach, which is exactly the dual-primary hazard.
+            faults.install("store.net", "error", where={"me": "P"})
+            faults.install("store.net", "error", where={"url": p_url})
+
+            # No dual-primary instant: sample both sides until the
+            # follower promotes; whenever the follower is writable the
+            # primary must already be suspended.
+            deadline = time.time() + 15
+            saw_promotion = False
+            while time.time() < deadline:
+                f_writable = follower.store_role.get("writable", False)
+                p_suspended = primary.store_role.get("suspended", False)
+                if f_writable:
+                    assert p_suspended, (
+                        "dual-primary window: follower writable while "
+                        "the minority primary still accepted writes"
+                    )
+                    saw_promotion = True
+                    break
+                time.sleep(0.02)
+            assert saw_promotion, "follower never promoted with quorum"
+            assert follower.store_role["term"] >= 2
+            assert arbiter.arbiter_state["voted_for"] == "F"
+
+            # minority side: writes 503 + Retry-After, reads keep serving
+            response = requests.post(
+                f"{p_url}/c/ds/insert_one",
+                json={"document": {ROW_ID: 99, "v": "split"}},
+                timeout=5,
+            )
+            assert response.status_code == 503
+            assert response.headers.get("Retry-After")
+            assert response.json()["kind"] == "writes_suspended"
+            assert requests.get(f"{p_url}/health", timeout=5).json()[
+                "suspended"
+            ]
+            read = requests.post(
+                f"{p_url}/c/ds/find",
+                json={"query": {}, "skip": 0, "limit": None},
+                timeout=5,
+            )
+            assert read.status_code == 200
+            assert len(read.json()["documents"]) == 1
+            # a single-URL client surfaces the suspension as the
+            # TRANSIENT StoreUnavailableError the scheduler retries
+            with pytest.raises(StoreUnavailableError):
+                RemoteStore(p_url, failover_timeout=0.2).insert_one(
+                    "ds", {ROW_ID: 50, "v": "blocked"}
+                )
+
+            # majority side: writes continue
+            majority = RemoteStore(f_url)
+            majority.insert_one("ds", {ROW_ID: 2, "v": "after"})
+            assert majority.count("ds") == 2
+            # the takeover terminated the WAL poller (no zombie pollers)
+            assert follower.store_role["poller"] is None
+
+            # heal: the old primary demotes to follower of the new one
+            # and resyncs the post-failover write
+            faults.reset()
+            _wait_for(
+                lambda: primary.store_role.get("writable") is False,
+                message="old primary demotion",
+            )
+            _wait_for(
+                lambda: primary.store.count("ds") == 2,
+                message="old primary resync",
+            )
+            assert follower.store_role["writable"] is True
+        finally:
+            faults.reset()
+            primary.stop()
+            follower.stop()
+            arbiter.stop()
+
+    def test_asymmetric_partition_cannot_keep_two_writers(self):
+        """Only the primary↔follower link fails; BOTH still reach the
+        arbiter. The follower legitimately wins self+arbiter and
+        promotes — and the old primary, whose voter quorum is still
+        numerically intact via the arbiter, must recognize the
+        arbiter's higher voted term as supersession and suspend
+        instead of staying a second writer."""
+        arbiter, primary, follower, p_url, f_url, _ = self._topology()
+        try:
+            client = RemoteStore(p_url)
+            client.insert_columns("ds", {"v": [1]})
+            _wait_for(
+                lambda: follower.store.count("ds") == 1,
+                message="follower sync",
+            )
+            # sever ONLY the P↔F link, both directions
+            faults.install(
+                "store.net", "error", where={"me": "P", "url": f_url}
+            )
+            faults.install(
+                "store.net", "error", where={"me": "F", "url": p_url}
+            )
+            _wait_for(
+                lambda: follower.store_role.get("writable"),
+                message="follower takeover via arbiter",
+            )
+            # the old primary heard the new term through the arbiter
+            _wait_for(
+                lambda: primary.store_role.get("suspended"),
+                message="old primary suspension on supersession",
+            )
+            response = requests.post(
+                f"{p_url}/c/ds/insert_one",
+                json={"document": {ROW_ID: 77}},
+                timeout=5,
+            )
+            assert response.status_code == 503
+            # heal: the fence demotes the old primary to the winner
+            faults.reset()
+            _wait_for(
+                lambda: primary.store_role.get("writable") is False,
+                message="old primary demotion after heal",
+            )
+        finally:
+            faults.reset()
+            primary.stop()
+            follower.stop()
+            arbiter.stop()
+
+    def test_failed_campaign_without_quorum(self):
+        """A follower that cannot assemble a majority (primary AND
+        arbiter unreachable) must keep refusing writes — graceful
+        degradation, not a blind timer promotion."""
+        arbiter, primary, follower, p_url, f_url, a_url = self._topology()
+        try:
+            # isolate the FOLLOWER: everything it dials fails
+            faults.install("store.net", "error", where={"me": "F"})
+            time.sleep(2.2)  # several auto-promote windows
+            assert follower.store_role["writable"] is False
+            with pytest.raises(PermissionError):
+                RemoteStore(f_url).insert_one("ds", {ROW_ID: 1})
+            # reads still serve on the degraded follower
+            assert RemoteStore(f_url).count("ds") == 0
+        finally:
+            faults.reset()
+            primary.stop()
+            follower.stop()
+            arbiter.stop()
+
+
+class TestLossWindow:
+    def test_takeover_reports_measured_loss_window(self):
+        """Delayed WAL shipping: the promotion response, /health, and
+        /metrics all report exactly the acknowledged records the
+        takeover cost (ROADMAP: failover cost must be visible)."""
+        primary = serve("127.0.0.1", 0, replicate=True)
+        follower = serve(
+            "127.0.0.1",
+            0,
+            primary_url=f"http://127.0.0.1:{primary.port}",
+        )
+        try:
+            follower.replication.stop()  # drive shipping by hand
+            poller = ReplicationClient(
+                follower.store,
+                f"http://127.0.0.1:{primary.port}",
+                batch=2,  # ship at most 2 records per poll
+            )
+            client = RemoteStore(f"http://127.0.0.1:{primary.port}")
+            client.create_collection("ds")
+            for i in range(1, 5):
+                client.insert_one("ds", {ROW_ID: i, "v": i})
+            poller.poll_once()  # resolves the epoch (resync)
+            poller.poll_once()  # applies 2 of the 5 records
+            assert poller.lag == 3
+            follower.store_role["poller"] = poller
+
+            response = requests.post(
+                f"http://127.0.0.1:{follower.port}/promote", timeout=10
+            ).json()
+            loss = response["loss_window"]
+            assert loss["records"] == 3
+            assert loss["primary_wal_length"] == 5
+            assert loss["applied_offset"] == 2
+            assert response["caught_up"] is False
+
+            health = requests.get(
+                f"http://127.0.0.1:{follower.port}/health", timeout=5
+            ).json()
+            assert health["loss_window"]["records"] == 3
+
+            metrics = requests.get(
+                f"http://127.0.0.1:{follower.port}/metrics", timeout=5
+            ).text
+            samples = [
+                line
+                for line in metrics.splitlines()
+                if line.startswith("lo_store_loss_window{")
+            ]
+            assert any(line.endswith(" 3") for line in samples), samples
+        finally:
+            primary.stop()
+            follower.stop()
+
+    def test_follower_health_reports_replication_lag(self):
+        primary = serve("127.0.0.1", 0, replicate=True)
+        follower = serve(
+            "127.0.0.1",
+            0,
+            primary_url=f"http://127.0.0.1:{primary.port}",
+        )
+        try:
+            client = RemoteStore(f"http://127.0.0.1:{primary.port}")
+            client.insert_columns("ds", {"a": [1, 2, 3]})
+            _wait_for(
+                lambda: follower.store.count("ds") == 3,
+                message="follower sync",
+            )
+            health = requests.get(
+                f"http://127.0.0.1:{follower.port}/health", timeout=5
+            ).json()
+            assert health["replication"]["lag"] == 0
+            assert health["replication"]["caught_up"] is True
+        finally:
+            primary.stop()
+            follower.stop()
+
+
+class TestSyncReplication:
+    def test_ack_waits_for_follower_and_flags_timeouts(self, monkeypatch):
+        monkeypatch.setenv("LO_REPL_INTERVAL_S", "0.05")
+        primary = serve(
+            "127.0.0.1",
+            0,
+            replicate=True,
+            sync_repl=True,
+            ack_timeout_s=0.3,
+        )
+        p_url = f"http://127.0.0.1:{primary.port}"
+        follower = None
+        try:
+            # no follower yet: the ack wait times out and the write is
+            # FLAGGED, not silently majority-acknowledged
+            started = time.monotonic()
+            response = requests.post(
+                f"{p_url}/c/ds/insert_one",
+                json={"document": {ROW_ID: 1, "v": 1}},
+                timeout=10,
+            )
+            assert time.monotonic() - started >= 0.3
+            assert response.json().get("replicated") is False
+            metrics = requests.get(f"{p_url}/metrics", timeout=5).text
+            assert any(
+                line.startswith("lo_store_unreplicated_acks{")
+                and line.endswith(" 1")
+                for line in metrics.splitlines()
+            )
+
+            follower = serve("127.0.0.1", 0, primary_url=p_url)
+            _wait_for(
+                lambda: follower.store.count("ds") == 1,
+                message="follower sync",
+            )
+            # with a live follower the ack confirms replication: no flag
+            response = requests.post(
+                f"{p_url}/c/ds/insert_one",
+                json={"document": {ROW_ID: 2, "v": 2}},
+                timeout=10,
+            )
+            assert "replicated" not in response.json()
+            assert follower.store.count("ds") >= 1
+        finally:
+            primary.stop()
+            if follower is not None:
+                follower.stop()
+
+
+class TestDevcacheAcrossFailover:
+    def test_rev_keyed_entries_never_serve_pre_failover_content(self):
+        """The devcache's rev probe + the store's per-boot random rev
+        base guarantee a post-failover read can't be served from a
+        pre-failover cache entry even though the collection name is
+        unchanged."""
+        from learningorchestra_tpu.core import devcache
+
+        devcache.reset_global_devcache()
+        primary = serve("127.0.0.1", 0, replicate=True)
+        follower = serve(
+            "127.0.0.1",
+            0,
+            primary_url=f"http://127.0.0.1:{primary.port}",
+        )
+        try:
+            store = RemoteStore(
+                f"http://127.0.0.1:{primary.port},"
+                f"http://127.0.0.1:{follower.port}",
+                failover_timeout=20,
+            )
+            store.insert_columns("ds", {"a": [1.0, 2.0]})
+            _wait_for(
+                lambda: follower.store.count("ds") == 2,
+                message="follower sync",
+            )
+            table = devcache.dataset_table(store, "ds", fields=["a"])
+            assert table.columns["a"].tolist() == [1.0, 2.0]
+
+            primary.stop()
+            requests.post(
+                f"http://127.0.0.1:{follower.port}/promote", timeout=10
+            )
+            survivor = RemoteStore(f"http://127.0.0.1:{follower.port}")
+            survivor.set_column("ds", "a", [7.0, 8.0])
+
+            again = devcache.dataset_table(store, "ds", fields=["a"])
+            assert again.columns["a"].tolist() == [7.0, 8.0], (
+                "devcache served pre-failover content after a takeover"
+            )
+        finally:
+            devcache.reset_global_devcache()
+            primary.stop()
+            follower.stop()
+
+
+def _spawn(env_extra, *argv):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(env_extra)
+    return subprocess.Popen(
+        [sys.executable, *argv],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+
+
+def _wait_line(process, marker, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = process.stdout.readline()
+        if not line:
+            if process.poll() is not None:
+                raise RuntimeError(f"process died (rc={process.returncode})")
+            time.sleep(0.05)
+            continue
+        if marker in line:
+            return line.strip()
+    raise TimeoutError(f"no {marker!r} line within {timeout}s")
+
+
+@pytest.mark.slow
+def test_kill_primary_mid_ingest_zero_lost_acked_writes(tmp_path):
+    """THE failover drill (ROADMAP: 'failover with zero lost
+    acknowledged writes'): a real primary process is killed by an armed
+    fault mid write burst — after the write applied but before the ack.
+    Under sync replication every acknowledged write is already on the
+    follower, the client rides the quorum takeover via its landed-ok
+    retry machinery, and the full ingest lands with nothing lost."""
+    p_port, f_port, a_port = _free_port(), _free_port(), _free_port()
+    p_url = f"http://127.0.0.1:{p_port}"
+    f_url = f"http://127.0.0.1:{f_port}"
+    a_url = f"http://127.0.0.1:{a_port}"
+    processes = []
+    try:
+        arbiter = _spawn(
+            {"LO_ARBITER_PORT": str(a_port)},
+            "-m",
+            "learningorchestra_tpu.core.arbiter",
+        )
+        processes.append(arbiter)
+        _wait_line(arbiter, "store arbiter on ")
+        shared = {
+            "LO_ARBITERS": a_url,
+            "LO_REPL_INTERVAL_S": "0.05",
+            "LO_STORE_MONITOR_TICK_S": "0.2",
+        }
+        primary = _spawn(
+            {
+                **shared,
+                "LO_STORE_PORT": str(p_port),
+                "LO_DATA_DIR": str(tmp_path / "p"),
+                "LO_REPLICATE": "1",
+                "LO_PEERS": f_url,
+                "LO_NODE_ID": "P",
+                "LO_STORE_SYNC_REPL": "1",
+                "LO_STORE_ACK_TIMEOUT_S": "5",
+                # die DURING the 8th mutation: applied, never acked
+                "LO_FAULT_STORE_WIRE_MUTATE_APPLIED": "kill:8",
+            },
+            "-m",
+            "learningorchestra_tpu.core.store_service",
+        )
+        processes.append(primary)
+        _wait_line(primary, "store server on ")
+        follower = _spawn(
+            {
+                **shared,
+                "LO_STORE_PORT": str(f_port),
+                "LO_DATA_DIR": str(tmp_path / "f"),
+                "LO_PRIMARY_URL": p_url,
+                "LO_PEERS": p_url,
+                "LO_NODE_ID": "F",
+                "LO_AUTO_PROMOTE_S": "1",
+            },
+            "-m",
+            "learningorchestra_tpu.core.store_service",
+        )
+        processes.append(follower)
+        _wait_line(follower, "store server on ")
+
+        client = RemoteStore(f"{p_url},{f_url}", failover_timeout=45)
+        client.create_collection("ds")  # mutation hit 1
+        acked = []
+        for i in range(1, 21):
+            # explicit ids: the idempotent, landed-ok-retryable shape
+            client.insert_one("ds", {ROW_ID: i, "v": f"row{i}"})
+            acked.append(i)
+
+        # the fault really killed the primary process
+        primary.wait(timeout=30)
+        assert primary.returncode == 137
+
+        survivor = RemoteStore(f_url)
+        health = requests.get(f"{f_url}/health", timeout=5).json()
+        assert health["writable"] is True
+        assert health["term"] >= 2
+        assert health.get("loss_window") is not None
+        # ZERO lost acknowledged writes: every acked row is present
+        # with its content on the surviving primary
+        rows = {
+            d[ROW_ID]: d["v"] for d in survivor.find("ds", {})
+        }
+        for i in acked:
+            assert rows.get(i) == f"row{i}", f"acked row {i} lost"
+        assert survivor.count("ds") == 20
+    finally:
+        for process in processes:
+            process.terminate()
+        for process in processes:
+            try:
+                process.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                process.kill()

@@ -14,6 +14,74 @@ from learningorchestra_tpu.ml import (
 )
 
 
+class TestEarlyExitPlateau:
+    """The tol early-exit must stop on a genuine plateau and ONLY on
+    one: a single floor-step Armijo iteration (one tiny loss delta
+    inside an otherwise-descending run) used to satisfy the check and
+    stop a fit mid-descent (ADVICE r5)."""
+
+    def test_plateaued_requires_every_delta_and_the_total(self):
+        from learningorchestra_tpu.ml.logistic import _plateaued
+
+        tol = 1e-6
+        # genuine plateau: stop
+        assert _plateaued([0.5, 0.5, 0.5, 0.5], tol, 4)
+        # momentary plateau (one tiny delta mid-descent): keep going
+        assert not _plateaued([1.0, 0.9999999, 0.99, 0.98], tol, 4)
+        assert not _plateaued([1.0, 0.99, 0.9899999, 0.97], tol, 4)
+        # too little history: keep going
+        assert not _plateaued([0.5, 0.5], tol, 4)
+        # slow steady descent whose per-step deltas all sneak under a
+        # loose tol but whose window total does not: keep going
+        loose = 1.1e-2
+        assert not _plateaued([1.03, 1.02, 1.01, 1.00], loose, 4)
+
+    def _scripted_fit(self, monkeypatch, value_at):
+        """Run logistic._fit with _fit_segment replaced by a scripted
+        loss curve; returns how many segments were consumed."""
+        from learningorchestra_tpu.ml import logistic
+
+        calls = {"segments": 0, "cursor": 0}
+
+        def fake_segment(params, opt_state, X, y, mask, iters, l2):
+            calls["segments"] += 1
+            start = calls["cursor"]
+            calls["cursor"] += iters
+            losses = np.asarray(
+                [value_at(start + k) for k in range(iters)], np.float32
+            )
+            return params, opt_state, losses
+
+        monkeypatch.setattr(logistic, "_fit_segment", fake_segment)
+        X = np.zeros((4, 2), np.float32)
+        y = np.zeros((4,), np.int32)
+        logistic._fit(
+            {"w": np.zeros((2, 2))},
+            X,
+            y,
+            np.ones((4,), np.float32),
+            max_iter=100,
+            l2=0.0,
+        )
+        return calls["segments"]
+
+    def test_momentary_plateau_does_not_terminate(self, monkeypatch):
+        # strictly descending except ONE flat step at iteration 31
+        def value_at(i):
+            effective = i if i < 31 else i - 1  # v(31) == v(30)
+            return 100.0 - effective * 0.1
+
+        # all four 25-iteration segments run: no early exit
+        assert self._scripted_fit(monkeypatch, value_at) == 4
+
+    def test_genuine_plateau_terminates_early(self, monkeypatch):
+        def value_at(i):
+            return max(1.0, 100.0 - i * 2.0)  # flat from iteration 50
+
+        # the segment covering iterations 50..74 ends on a real plateau
+        assert self._scripted_fit(monkeypatch, value_at) == 3
+
+
 @pytest.fixture()
 def blobs(rng):
     """Linearly separable-ish 3-class data."""

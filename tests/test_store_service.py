@@ -723,3 +723,116 @@ class TestFailoverLandedWrites:
             store._send(
                 lambda base: self._R409(), retry=True, landed_ok=True
             )
+
+    def test_5xx_is_ambiguous_like_a_dropped_connection(self, monkeypatch):
+        """A 500 mid-request (handler died after maybe applying) must
+        ride the same landed-ok retry as a connection death."""
+
+        class _R500:
+            status_code = 500
+            reason = "boom"
+            url = "http://a"
+
+            def raise_for_status(self):
+                import requests as rq
+
+                raise rq.HTTPError("500", response=self)
+
+            def json(self):
+                return {}
+
+        store_service = self._patched(monkeypatch)
+        store = store_service.RemoteStore(
+            "http://a,http://b", failover_timeout=5
+        )
+        calls = []
+
+        def send(base):
+            calls.append(base)
+            return _R500() if len(calls) == 1 else self._R409()
+
+        response = store._send(send, retry=True, landed_ok=True)
+        assert response.status_code == 409  # swallowed: the write landed
+
+
+class TestCrossCallLandedWrites:
+    """The residual ADVICE-r5 hole: the ambiguous failure and the
+    duplicate-id 409 happen in DIFFERENT _send calls — the write landed
+    on the dying primary, the client's op-level error propagated, and a
+    higher-level retry (the scheduler re-running the ingest) replays
+    it. The replay's clean-attempt 409 must verify by read and succeed
+    instead of aborting a fully durable ingest."""
+
+    @pytest.fixture()
+    def live(self):
+        from learningorchestra_tpu.core.store_service import (
+            RemoteStore,
+            create_store_app,
+        )
+
+        server = ServerThread(
+            create_store_app(InMemoryStore()), "127.0.0.1", 0
+        ).start()
+        yield RemoteStore(f"http://127.0.0.1:{server.port}"), server
+        server.stop()
+
+    def _fail_next_post_ambiguously(self, store, monkeypatch):
+        """Make exactly one _session.post die AFTER the server applied
+        the write — the landed-but-unacked shape."""
+        import requests as rq
+
+        real_session = store._session
+        state = {"armed": True}
+        real_post = real_session.post
+
+        def flaky_post(url, **kwargs):
+            response = real_post(url, **kwargs)
+            if state["armed"]:
+                state["armed"] = False
+                raise rq.ConnectionError("died after the server applied")
+            return response
+
+        monkeypatch.setattr(real_session, "post", flaky_post)
+
+    def test_scheduler_style_replay_of_landed_insert_succeeds(
+        self, live, monkeypatch
+    ):
+        store, _ = live
+        self._fail_next_post_ambiguously(store, monkeypatch)
+        with pytest.raises(Exception):  # the op-level failure the
+            store.insert_one("ds", {ROW_ID: 1, "v": "x"})  # sched sees
+        # the sched-level retry replays the op; the row is already
+        # durable server-side, and the replay must treat it as landed
+        store.insert_one("ds", {ROW_ID: 1, "v": "x"})
+        assert store.count("ds") == 1
+
+    def test_replay_with_different_content_still_raises(
+        self, live, monkeypatch
+    ):
+        store, _ = live
+        self._fail_next_post_ambiguously(store, monkeypatch)
+        with pytest.raises(Exception):
+            store.insert_one("ds", {ROW_ID: 1, "v": "x"})
+        # same id, DIFFERENT content: a genuine conflict, not a replay
+        with pytest.raises(KeyError):
+            store.insert_one("ds", {ROW_ID: 1, "v": "different"})
+
+    def test_replay_of_landed_column_chunk_succeeds(
+        self, live, monkeypatch
+    ):
+        store, _ = live
+        self._fail_next_post_ambiguously(store, monkeypatch)
+        columns = {"a": [1.0, 2.0, 3.0], "b": ["x", "y", "z"]}
+        with pytest.raises(Exception):
+            store.insert_columns("ds", columns, start_id=1)
+        store.insert_columns("ds", columns, start_id=1)  # the replay
+        assert store.read_columns("ds", ["a"])["a"] == [1.0, 2.0, 3.0]
+
+    def test_unmarked_collection_keeps_duplicate_semantics(self, live):
+        store, _ = live
+        store.insert_one("ds", {ROW_ID: 1, "v": "x"})
+        # no ambiguity ever happened on this client: identical replay
+        # is still a duplicate — the verify path only opens after an
+        # ambiguous failure on the same collection
+        with pytest.raises(KeyError):
+            store.insert_one("ds", {ROW_ID: 1, "v": "x"})
