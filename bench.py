@@ -22,6 +22,10 @@ Four sections, all on the visible chip(s):
    and an analytic lower bound for the LR fit (its two matmuls per
    L-BFGS iteration — tabular fits are HBM-bound, so this is honest
    and small).
+5. **Serve**: closed-loop load against the online predict lane
+   (docs/serving.md) at 1 / 8 / 64 concurrent clients — p50/p99
+   latency, predictions/s, achieved mean batch size
+   (``LO_BENCH_SERVE_REQUESTS`` per client, default 100).
 
 Prints exactly ONE JSON line: the headline kernel metric (metric/value/
 unit/vs_baseline, same name as previous rounds) with everything else
@@ -472,6 +476,72 @@ def bench_product(X, y) -> dict:
     }
 
 
+def bench_serve() -> dict:
+    """Serve section: closed-loop load against the online predict lane
+    (docs/serving.md) at 1 / 8 / 64 concurrent clients — p50/p99
+    latency, predictions/s, and the achieved mean batch size (the
+    number that proves concurrent singles coalesce into shared
+    dispatches)."""
+    import tempfile
+
+    from learningorchestra_tpu.core.store import InMemoryStore
+    from learningorchestra_tpu.ml.base import make_classifier
+    from learningorchestra_tpu.ml.checkpoint import checkpoint_path, save_model
+    from learningorchestra_tpu.serve import ServePlane
+    from learningorchestra_tpu.serve.loadgen import run_closed_loop
+    from learningorchestra_tpu.services import model_builder
+
+    import shutil
+
+    X, y = _synthetic(2_048, seed=5)
+    model = make_classifier("lr").fit(X, y)
+    models_dir = tempfile.mkdtemp(prefix="lo_serve_bench_")
+    name = "bench_serve_prediction_lr"
+    save_model(model, checkpoint_path(models_dir, name))
+    plane = ServePlane()
+    app = model_builder.create_app(
+        InMemoryStore(), models_dir=models_dir, serve=plane
+    )
+    requests_per_client = int(os.environ.get("LO_BENCH_SERVE_REQUESTS", "100"))
+    row = X[:1].tolist()
+    levels: dict = {}
+    try:
+        for clients in (1, 8, 64):
+            if _budget_left() < 20:
+                levels[str(clients)] = {"skipped": "budget"}
+                continue
+            handles = [app.test_client() for _ in range(clients)]
+
+            def send(index, handles=handles):
+                response = handles[index].post(
+                    f"/models/{name}/predict", json={"rows": row}
+                )
+                if response.status_code != 200:
+                    raise RuntimeError(
+                        f"predict failed: HTTP {response.status_code}"
+                    )
+
+            before = plane.batcher.stats()
+            stats = run_closed_loop(send, clients, requests_per_client)
+            after = plane.batcher.stats()
+            batches = after["batches"] - before["batches"]
+            grouped = after["batched_requests"] - before["batched_requests"]
+            stats["mean_batch_size"] = (
+                round(grouped / batches, 2) if batches else None
+            )
+            levels[str(clients)] = stats
+        return {
+            "model": "lr",
+            "rows_per_request": 1,
+            "requests_per_client": requests_per_client,
+            "levels": levels,
+            "registry": plane.registry.stats(),
+        }
+    finally:
+        plane.close()
+        shutil.rmtree(models_dir, ignore_errors=True)
+
+
 def bench_embeddings() -> dict:
     """Section 3: the PCA + t-SNE north-star wall-clocks."""
     from learningorchestra_tpu.ops.pca import pca_embedding
@@ -715,6 +785,7 @@ def main() -> None:
     # eat the budget, the first casualty must be the diagnostic, not
     # the product-path or embeddings measurements.
     section("product_path", lambda: bench_product(X, y))
+    section("serve", bench_serve)  # the online predict lane's latency
     section("embeddings", bench_embeddings)
     section("kernels_wide", bench_kernels_wide)
 
@@ -754,6 +825,16 @@ def main() -> None:
             summary["devcache_warm"] = {
                 "hits": warm_cache.get("hits"),
                 "misses": warm_cache.get("misses"),
+            }
+    serve = extra.get("serve")
+    if isinstance(serve, dict):
+        top = serve.get("levels", {}).get("64")
+        if isinstance(top, dict) and "p99_ms" in top:
+            summary["serve_64c"] = {
+                "p50_ms": top.get("p50_ms"),
+                "p99_ms": top.get("p99_ms"),
+                "predictions_per_s": top.get("predictions_per_s"),
+                "mean_batch_size": top.get("mean_batch_size"),
             }
     embeddings = extra.get("embeddings")
     if isinstance(embeddings, dict):

@@ -51,6 +51,14 @@ Manifest (JSON)::
         "store_compress": 0,       #   LO_STORE_COMPRESS (1 = zlib wire)
         "write_overlap": 1         #   LO_WRITE_OVERLAP (0 = sync writes)
       },
+      "serving": {                 # optional online-serving knobs
+        "serve_bytes": 1000000000, #   LO_SERVE_BYTES (0 = host fallback)
+        "batch_window_ms": 1,      #   LO_SERVE_BATCH_WINDOW_MS (>= 0)
+        "max_batch": 64,           #   LO_SERVE_MAX_BATCH (>= 1)
+        "max_rows": 4096,          #   LO_SERVE_MAX_ROWS (413 past it)
+        "queue_cap": 256,          #   LO_SERVE_QUEUE_CAP (429 past it)
+        "timeout_s": 30            #   LO_SERVE_TIMEOUT_S (> 0)
+      },
       "replication": {             # optional replicated store plane
         "enabled": true,           #   (docs/replication.md): the head
         "follower_port": 27028,    #   runs primary + WAL-shipping
@@ -144,6 +152,31 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("dataplane.devcache_bytes must be >= 0")
         elif value not in (0, 1):
             raise SystemExit(f"dataplane.{key} must be 0 or 1")
+    serving = manifest.setdefault("serving", {})
+    for key in serving:
+        if key not in _SERVING_KNOBS:
+            raise SystemExit(
+                f"unknown serving knob {key!r} (have: "
+                f"{', '.join(sorted(_SERVING_KNOBS))})"
+            )
+        value = serving[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SystemExit(f"serving.{key} must be a number")
+        if key in ("serve_bytes", "max_batch", "max_rows", "queue_cap") and (
+            not isinstance(value, int)
+        ):
+            raise SystemExit(f"serving.{key} must be an integer")
+        if key == "serve_bytes":
+            if value < 0:  # 0 = host-only fallback, still valid
+                raise SystemExit("serving.serve_bytes must be >= 0")
+        elif key == "batch_window_ms":
+            if value < 0:
+                raise SystemExit("serving.batch_window_ms must be >= 0")
+        elif key == "timeout_s":
+            if value <= 0:
+                raise SystemExit("serving.timeout_s must be > 0")
+        elif value < 1:
+            raise SystemExit(f"serving.{key} must be >= 1")
     replication = manifest.setdefault("replication", {})
     for key in replication:
         if key not in _REPLICATION_KNOBS:
@@ -201,6 +234,19 @@ _DATAPLANE_KNOBS = {
     "write_overlap": "LO_WRITE_OVERLAP",
 }
 
+# manifest serving.<knob> -> the env var every machine receives
+# (docs/serving.md). Only the head serves REST today, but the knobs go
+# cluster-wide like the others: a failover promotion or a future
+# per-host serving lane must not inherit silently different budgets.
+_SERVING_KNOBS = {
+    "serve_bytes": "LO_SERVE_BYTES",
+    "batch_window_ms": "LO_SERVE_BATCH_WINDOW_MS",
+    "max_batch": "LO_SERVE_MAX_BATCH",
+    "max_rows": "LO_SERVE_MAX_ROWS",
+    "queue_cap": "LO_SERVE_QUEUE_CAP",
+    "timeout_s": "LO_SERVE_TIMEOUT_S",
+}
+
 # manifest replication.<knob> (docs/replication.md); the head machine
 # runs the whole store plane, every machine's LO_STORE_URL names the
 # primary AND the follower for client-side failover
@@ -253,6 +299,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _DATAPLANE_KNOBS.items():
         if knob in manifest.get("dataplane", {}):
             shared[env_var] = str(manifest["dataplane"][knob])
+    for knob, env_var in _SERVING_KNOBS.items():
+        if knob in manifest.get("serving", {}):
+            shared[env_var] = str(manifest["serving"][knob])
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 

@@ -32,6 +32,18 @@
 #   LO_WRITE_OVERLAP      0 = synchronous prediction write-back
 #                         (default 1: writes overlap the next fit)
 #
+# Serving knobs (docs/serving.md has the full table):
+#   LO_SERVE_BYTES           device-byte budget for pinned models
+#                            (default 1e9; 0 = host-only fallback —
+#                            correct, just loads per request)
+#   LO_SERVE_BATCH_WINDOW_MS micro-batch collection window (default 1)
+#   LO_SERVE_MAX_BATCH       max requests per forward dispatch (64)
+#   LO_SERVE_MAX_ROWS        max rows per predict request; past it the
+#                            route answers 413         (default 4096)
+#   LO_SERVE_QUEUE_CAP       bounded batcher inbox; past it predicts
+#                            get HTTP 429 + Retry-After    (default 256)
+#   LO_SERVE_TIMEOUT_S       per-request wait bound → 503  (default 30)
+#
 # Replication / failover knobs (docs/replication.md has the full table):
 #   LO_REPLICATION        1 = replicated store plane (primary + follower
 #                         + quorum arbiter) when run under deploy/stack.py
@@ -61,6 +73,10 @@ from learningorchestra_tpu.sched import config
 config.host_width(); config.device_width(); config.queue_cap()
 from learningorchestra_tpu.core import devcache
 devcache.capacity_bytes()
+# serving knobs: reject non-numeric / out-of-range before bring-up
+# (window >= 0, bytes >= 0 with 0 = host-only fallback)
+from learningorchestra_tpu.serve import config as serve_config
+serve_config.validate_all()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
              "LO_STORE_SYNC_REPL"):
     value = os.environ.get(knob, "").strip()

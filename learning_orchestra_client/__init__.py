@@ -8,6 +8,11 @@ byte-compatible client (learningorchestra_tpu/client.py — same class
 names, banners, ports, poll loop, including the reference's
 ``AsyncronousWait``/``READE`` spellings), so the documented walkthrough
 runs against the TPU framework with only the cluster IP changed.
+
+Beyond the reference surface, ``Model`` additionally exposes the online
+serving lane (``Model.predict(model_name, rows)`` /
+``Model.list_models()`` → ``POST /models/<name>/predict`` — synchronous
+labels + probabilities, no polling; docs/serving.md).
 """
 
 from learningorchestra_tpu.client import (  # noqa: F401

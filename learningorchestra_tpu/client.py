@@ -328,3 +328,23 @@ class Model(_RestClient):
             "classificators_list": model_classificator,
         }
         return self._post(body=body, pretty_response=pretty_response)
+
+    # --- online serving (beyond the reference surface; docs/serving.md) ---
+    def predict(self, model_name, rows, pretty_response: bool = True):
+        """Synchronous predictions from a built model: ``rows`` (a list
+        of numeric feature rows) in, labels + probabilities out — no job
+        to poll. The 429/Retry-After and 404 cases surface through the
+        standard ``ResponseTreat`` semantics."""
+        if pretty_response:
+            _banner(" PREDICT WITH " + model_name + " ")
+        return self._post(
+            model_name + "/predict",
+            body={"rows": rows},
+            pretty_response=pretty_response,
+        )
+
+    def list_models(self, pretty_response: bool = True):
+        """Built model names plus serving-registry occupancy."""
+        if pretty_response:
+            _banner(" LIST MODELS ")
+        return self._get(pretty_response=pretty_response)

@@ -1,0 +1,91 @@
+"""Online serving: device-resident model registry + request micro-batching.
+
+The reference system is batch-only — a model lives and dies inside one
+build job and the only way to get a prediction is to submit another job
+and poll (SURVEY §1). This package turns the checkpoints the builder
+already persists (``ml/checkpoint.py``) into an interactive surface:
+
+- :class:`~learningorchestra_tpu.serve.registry.ModelRegistry` pins
+  predict-ready models in device memory, rev-keyed against the artifact
+  on disk and byte-budgeted like the data plane's devcache
+  (``LO_SERVE_BYTES``, LRU; 0 = host-only fallback).
+- :class:`~learningorchestra_tpu.serve.batcher.MicroBatcher` coalesces
+  predict requests arriving within ``LO_SERVE_BATCH_WINDOW_MS`` into one
+  padded forward dispatch per model and scatters results back to the
+  waiting request threads, honoring the scheduler's 429 + Retry-After
+  admission contract at its bounded inbox.
+- :class:`ServePlane` owns one of each — the unit the model_builder
+  service wires behind ``POST /models/<name>/predict``
+  (docs/serving.md).
+
+One process-wide plane (:func:`global_serve_plane`) serves production;
+tests construct standalone planes with explicit knobs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from learningorchestra_tpu.serve.batcher import SERVE_CLASS, MicroBatcher
+from learningorchestra_tpu.serve.registry import (
+    ModelNotFoundError,
+    ModelRegistry,
+    artifact_rev,
+)
+
+
+class ServePlane:
+    """Registry + batcher, constructed together so their knobs resolve
+    at the same instant and tests can swap the whole plane."""
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        window_s: Optional[float] = None,
+        max_batch: Optional[int] = None,
+        inbox_cap: Optional[int] = None,
+        mesh=None,
+    ):
+        self.registry = ModelRegistry(capacity=capacity, mesh=mesh)
+        self.batcher = MicroBatcher(
+            self.registry,
+            window_s=window_s,
+            max_batch=max_batch,
+            inbox_cap=inbox_cap,
+        )
+
+    def submit(self, path: str, rows):
+        return self.batcher.submit(path, rows)
+
+    def stats(self) -> dict:
+        return {"registry": self.registry.stats(), **self.batcher.stats()}
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+_GLOBAL: Optional[ServePlane] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_serve_plane() -> ServePlane:
+    """The process-wide plane every model_builder app shares (entries
+    are keyed by absolute checkpoint path, so apps over different model
+    volumes coexist)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ServePlane()
+        return _GLOBAL
+
+
+__all__ = [
+    "MicroBatcher",
+    "ModelNotFoundError",
+    "ModelRegistry",
+    "SERVE_CLASS",
+    "ServePlane",
+    "artifact_rev",
+    "global_serve_plane",
+]

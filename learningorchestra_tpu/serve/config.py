@@ -1,0 +1,98 @@
+"""Serving knobs, env-configurable with validation.
+
+Same posture as ``sched/config.py``: every knob is read at ServePlane
+construction (not import) so tests monkeypatch the environment, and a
+malformed value raises immediately with the offending text —
+``deploy/run.sh`` preflights all of them so a typo fails bring-up
+instead of silently serving at a default.
+
+Knob table (documented in docs/serving.md):
+
+==============================  =======  ==================================
+env var                         default  meaning
+==============================  =======  ==================================
+``LO_SERVE_BYTES``              1e9      registry device-byte budget; past
+                                         it LRU eviction; ``0`` = host-only
+                                         fallback (load per request, no
+                                         pinning)
+``LO_SERVE_BATCH_WINDOW_MS``    1.0      micro-batch collection window in
+                                         milliseconds (``0`` = dispatch
+                                         immediately, still draining any
+                                         backlog into one batch)
+``LO_SERVE_MAX_BATCH``          64       max requests coalesced into one
+                                         forward dispatch (also the row
+                                         count small batches pad to, and
+                                         the row budget past which
+                                         collection stops early)
+``LO_SERVE_MAX_ROWS``           4096     max rows in ONE predict request;
+                                         past it the route answers 413 —
+                                         bulk scoring belongs on the batch
+                                         lane (``/predictions``)
+``LO_SERVE_QUEUE_CAP``          256      bounded batcher inbox; past it
+                                         submissions get HTTP 429 +
+                                         ``Retry-After``
+``LO_SERVE_TIMEOUT_S``          30       per-request wait bound before the
+                                         route answers 503 (the batcher
+                                         drops abandoned requests instead
+                                         of running their forwards)
+==============================  =======  ==================================
+"""
+
+from __future__ import annotations
+
+# One env-parsing implementation for both knob families: count knobs
+# are strictly integral (LO_SERVE_MAX_BATCH=1.5 silently truncating to
+# 1 would disable micro-batching — the misconfiguration-by-typo this
+# module exists to refuse, and what the manifest validation in
+# deploy/cluster.py already rejects).
+from learningorchestra_tpu.sched.config import _float_env, _int_env
+
+DEFAULT_SERVE_BYTES = 1_000_000_000
+
+
+def serve_bytes() -> int:
+    """Registry capacity in bytes of pinned model parameters.
+    ``0`` disables pinning entirely (host-only fallback: every predict
+    loads the checkpoint fresh — correct, just slower). Scientific
+    notation accepted (``1e9``), same as ``LO_DEVCACHE_BYTES``."""
+    return int(_float_env("LO_SERVE_BYTES", DEFAULT_SERVE_BYTES, 0))
+
+
+def batch_window_s() -> float:
+    """The micro-batch collection window, converted to seconds."""
+    return _float_env("LO_SERVE_BATCH_WINDOW_MS", 1.0, 0.0) / 1000.0
+
+
+def max_batch() -> int:
+    return _int_env("LO_SERVE_MAX_BATCH", 64, 1)
+
+
+def max_rows() -> int:
+    """Row cap per predict request. The online lane is for low-latency
+    scoring; an uncapped body would let one request drive an unbounded
+    H2D + device allocation on the latency path."""
+    return _int_env("LO_SERVE_MAX_ROWS", 4096, 1)
+
+
+def queue_cap() -> int:
+    return _int_env("LO_SERVE_QUEUE_CAP", 256, 1)
+
+
+def request_timeout_s() -> float:
+    value = _float_env("LO_SERVE_TIMEOUT_S", 30.0, 0.0)
+    if value <= 0:
+        raise ValueError(f"LO_SERVE_TIMEOUT_S must be > 0, got {value}")
+    return value
+
+
+def validate_all() -> dict:
+    """Read every serving knob once — the deploy preflight entry point.
+    Returns the resolved values so callers can log them."""
+    return {
+        "serve_bytes": serve_bytes(),
+        "batch_window_s": batch_window_s(),
+        "max_batch": max_batch(),
+        "max_rows": max_rows(),
+        "queue_cap": queue_cap(),
+        "request_timeout_s": request_timeout_s(),
+    }

@@ -1,0 +1,73 @@
+"""Closed-loop load generator for the serving path.
+
+Closed-loop (each client issues its next request only after the
+previous one answered) is the honest shape for latency measurement: an
+open-loop generator overruns a saturated server and measures its own
+queue. ``bench.py``'s ``serve`` section drives this at 1 / 8 / 64
+concurrent clients and reports p50/p99 latency, predictions/s, and the
+achieved mean batch size — the number that proves micro-batching
+actually coalesced concurrent singles into shared dispatches.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def run_closed_loop(
+    send: Callable[[int], None],
+    clients: int,
+    requests_per_client: int,
+    rows_per_request: int = 1,
+) -> dict:
+    """Run ``clients`` threads, each issuing ``requests_per_client``
+    back-to-back calls to ``send(client_index)`` (which must perform one
+    predict round-trip and raise on failure). Returns latency/throughput
+    stats; any client error is re-raised after the loop drains."""
+    latencies: list[list[float]] = [[] for _ in range(clients)]
+    errors: list[Optional[BaseException]] = [None] * clients
+    barrier = threading.Barrier(clients + 1)
+
+    def client(index: int) -> None:
+        mine = latencies[index]
+        try:
+            barrier.wait()
+            for _ in range(requests_per_client):
+                started = time.perf_counter()
+                send(index)
+                mine.append(time.perf_counter() - started)
+        except BaseException as error:  # noqa: BLE001 — reported below
+            errors[index] = error
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(clients)
+    ]
+    for thread in threads:
+        thread.start()
+    barrier.wait()  # all clients release together: a real burst
+    started = time.perf_counter()
+    for thread in threads:
+        thread.join()
+    wall_s = time.perf_counter() - started
+    for error in errors:
+        if error is not None:
+            raise error
+    flat = np.array([value for per in latencies for value in per])
+    requests = int(flat.size)
+    return {
+        "clients": clients,
+        "requests": requests,
+        "wall_s": round(wall_s, 3),
+        "p50_ms": round(float(np.percentile(flat, 50)) * 1000, 3),
+        "p99_ms": round(float(np.percentile(flat, 99)) * 1000, 3),
+        "mean_ms": round(float(flat.mean()) * 1000, 3),
+        "requests_per_s": round(requests / wall_s, 1),
+        "predictions_per_s": round(
+            requests * rows_per_request / wall_s, 1
+        ),
+    }

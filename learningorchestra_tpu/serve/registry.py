@@ -1,0 +1,248 @@
+"""Device-resident model registry: fitted params pinned in device memory.
+
+The batch pipeline loads a checkpoint from disk for every
+checkpoint-predict job (``ml/builder.py`` ``predict_with_model``) — fine
+for jobs that run minutes, fatal for a request that must answer in
+milliseconds. This registry keeps predict-ready models (their parameter
+arrays already on device) in a process-wide, byte-budgeted LRU, the same
+shape as the data plane's ``core/devcache.py``:
+
+- Entries are keyed by the checkpoint's absolute **path** and stamped
+  with the artifact's **rev** — ``(st_ino, st_mtime_ns, st_size)`` of
+  the file. ``write_checkpoint`` publishes atomically via ``os.replace``
+  (new inode), so a rebuild that overwrites the artifact always moves
+  the rev and the next lookup reloads: the registry can never serve
+  stale HBM after a rebuild.
+- The byte budget (``LO_SERVE_BYTES``) counts the models' device
+  parameter bytes; past it the least-recently-used model is dropped.
+  A budget of ``0`` (or a model bigger than the whole budget) degrades
+  to the **host fallback**: the checkpoint is loaded fresh for that
+  request and never cached — slower, still correct.
+- Models load onto the process's **local** devices only
+  (``local_mesh``): a serving forward must never enter a cross-host
+  collective, because the batcher bypasses the scheduler's device queue
+  and worker hosts run no batcher to meet it (docs/serving.md).
+
+Import cost: stdlib only — jax and the checkpoint loader are imported
+lazily inside :meth:`ModelRegistry.get`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+
+class ModelNotFoundError(KeyError):
+    """No checkpoint artifact at the requested path (never built, or
+    deleted between the route's existence check and the dispatch)."""
+
+
+Rev = tuple  # (st_ino, st_mtime_ns, st_size)
+
+
+def artifact_rev(path: str) -> Optional[Rev]:
+    """The artifact's identity on disk, or None when it does not exist.
+    ``os.replace`` publication gives a fresh inode per rebuild, so this
+    triple moves even when mtime granularity would not."""
+    try:
+        stat = os.stat(path)
+    except OSError:
+        return None
+    return (stat.st_ino, stat.st_mtime_ns, stat.st_size)
+
+
+def local_mesh():
+    """All devices addressable by THIS process on the data axis.
+
+    Single-process: identical to ``default_mesh``. Multi-host: the
+    serving forward stays host-local — the SPMD worker processes never
+    see these dispatches, so a global mesh would deadlock its first
+    collective."""
+    import jax
+
+    from learningorchestra_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.local_devices())
+
+
+def _model_nbytes(model) -> int:
+    return sum(int(leaf.nbytes) for leaf in model.device_state())
+
+
+class _Entry:
+    __slots__ = ("model", "rev", "nbytes", "kind")
+
+    def __init__(self, model, rev: Rev, nbytes: int, kind: str):
+        self.model = model
+        self.rev = rev
+        self.nbytes = nbytes
+        self.kind = kind
+
+
+class ModelRegistry:
+    """Byte-budgeted LRU of predict-ready models keyed by artifact path.
+
+    The lock guards the map only — checkpoint loads (disk unzip +
+    host-to-device transfer, seconds for a big model) run OUTSIDE it,
+    so a ``GET /models`` stats probe never stalls behind a load. The
+    batcher's single worker thread is the only production loader, so
+    two concurrent loads of one path cannot happen there; if test/
+    library callers race, the second insert replaces the first — wasted
+    work, never a wrong answer or a leaked byte count.
+    """
+
+    def __init__(self, capacity: Optional[int] = None, mesh=None):
+        from learningorchestra_tpu.serve import config
+
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self.capacity = config.serve_bytes() if capacity is None else capacity
+        self._mesh = mesh
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self._metrics = _serve_registry_metrics()
+
+    def _resolve_mesh(self):
+        if self._mesh is None:
+            self._mesh = local_mesh()
+        return self._mesh
+
+    def _load(self, path: str):
+        from learningorchestra_tpu.ml.checkpoint import load_model
+        from learningorchestra_tpu.telemetry import span
+
+        with span("serve:load_model", path=path):
+            return load_model(path, mesh=self._resolve_mesh())
+
+    def get(self, path: str):
+        """The predict-ready model for ``path``; loads (and pins, budget
+        permitting) on miss, reloads when the artifact rev moved.
+        Raises :class:`ModelNotFoundError` when no artifact exists."""
+        path = os.path.abspath(path)
+        rev = artifact_rev(path)
+        if rev is None:
+            with self._lock:
+                self._drop_locked(path, invalidation=True)
+            raise ModelNotFoundError(path)
+        with self._lock:
+            entry = self._entries.get(path)
+            if entry is not None and entry.rev == rev:
+                self._entries.move_to_end(path)
+                self.hits += 1
+                self._metrics["hits"].inc()
+                return entry.model
+            if entry is not None:
+                # a rebuild moved the artifact: never serve stale HBM
+                self._drop_locked(path, invalidation=True)
+            self.misses += 1
+            self._metrics["misses"].inc()
+        try:
+            model = self._load(path)  # unlocked: probes stay O(us)
+        except FileNotFoundError:
+            # deleted between artifact_rev() and the open: the same
+            # late-404 contract as a failed stat, not a 500
+            raise ModelNotFoundError(path) from None
+        nbytes = _model_nbytes(model)
+        if 0 < nbytes <= self.capacity:
+            with self._lock:
+                if path in self._entries:  # a racing loader beat us
+                    self._drop_locked(path)
+                while self.bytes + nbytes > self.capacity and self._entries:
+                    oldest = next(iter(self._entries))
+                    self._drop_locked(oldest)
+                    self.evictions += 1
+                    self._metrics["evictions"].inc()
+                self._entries[path] = _Entry(
+                    model, rev, nbytes, type(model).__name__
+                )
+                self.bytes += nbytes
+                self._metrics["bytes"].set(self.bytes)
+                self._metrics["models"].set(len(self._entries))
+        # over-budget (or capacity 0): host fallback — hand the
+        # freshly loaded model through without pinning it
+        return model
+
+    def _drop_locked(self, path: str, invalidation: bool = False) -> None:
+        entry = self._entries.pop(path, None)
+        if entry is not None:
+            self.bytes -= entry.nbytes
+            if invalidation:
+                self.invalidations += 1
+                self._metrics["invalidations"].inc()
+            self._metrics["bytes"].set(self.bytes)
+            self._metrics["models"].set(len(self._entries))
+
+    def status(self, path: str) -> dict:
+        """Residency info for ``GET /models/<name>`` — no load."""
+        path = os.path.abspath(path)
+        with self._lock:
+            entry = self._entries.get(path)
+            if entry is None:
+                return {"resident": False}
+            return {
+                "resident": entry.rev == artifact_rev(path),
+                "bytes": entry.nbytes,
+                "kind": entry.kind,
+            }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "models": len(self._entries),
+                "bytes": self.bytes,
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }
+
+
+_METRICS: Optional[dict] = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _serve_registry_metrics() -> dict:
+    """Registry counters/gauges, declared once per process. Counters
+    increment eagerly (families are shared get-or-create, so several
+    registries in one test process report into one family; production
+    runs exactly one — docs/observability.md)."""
+    global _METRICS
+    with _METRICS_LOCK:
+        if _METRICS is None:
+            from learningorchestra_tpu.telemetry import global_registry
+
+            registry = global_registry()
+            _METRICS = {
+                "hits": registry.counter(
+                    "lo_serve_registry_hits_total",
+                    "Predict dispatches served from a pinned model",
+                ),
+                "misses": registry.counter(
+                    "lo_serve_registry_misses_total",
+                    "Predict dispatches that loaded the checkpoint",
+                ),
+                "evictions": registry.counter(
+                    "lo_serve_registry_evictions_total",
+                    "Models dropped by the LRU byte budget",
+                ),
+                "invalidations": registry.counter(
+                    "lo_serve_registry_invalidations_total",
+                    "Models dropped because the artifact rev moved",
+                ),
+                "bytes": registry.gauge(
+                    "lo_serve_registry_bytes",
+                    "Device bytes of pinned model parameters",
+                ),
+                "models": registry.gauge(
+                    "lo_serve_registry_models",
+                    "Models resident in the serving registry",
+                ),
+            }
+        return _METRICS

@@ -17,7 +17,10 @@ Each module exposes ``create_app(store, ...) -> WebApp``; the reference's
 per-service Flask processes map to ``services.runner`` which serves any
 subset against a shared store.
 
-Beyond the reference surface, every service answers ``GET /metrics``
+Beyond the reference surface, model_builder also serves the ONLINE
+prediction lane (``POST /models/<name>/predict`` — synchronous labels +
+probabilities from a device-resident model registry with request
+micro-batching, docs/serving.md), and every service answers ``GET /metrics``
 (Prometheus text exposition — request counts/latency, job states,
 jitcache hit/miss, store occupancy; see docs/observability.md) and the
 job surface (``GET /jobs``, ``GET /jobs/<name>/trace``,

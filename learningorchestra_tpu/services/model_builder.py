@@ -10,15 +10,27 @@ Beyond reference parity, fitted models persist as checkpoints
 ``GET /models`` lists artifacts, ``GET /models/<name>`` describes one,
 ``POST /models/<name>/predictions`` predicts from the artifact without
 refitting — the durability the reference lacks (its fitted models die
-with the request, model_builder.py:232-247; SURVEY.md §5)."""
+with the request, model_builder.py:232-247; SURVEY.md §5).
+
+``POST /models/<name>/predict`` is the ONLINE lane (docs/serving.md):
+rows in the request body, labels + probabilities in the synchronous
+response — no job record, no store round-trip, no polling. Requests run
+through the serving plane (``serve/``): the model's params stay pinned
+in device memory (rev-keyed against the artifact, so a rebuild is never
+served stale) and concurrent requests coalesce into one padded forward
+dispatch per model. The lane bypasses the scheduler's device queue but
+keeps its admission contract: a full batcher inbox answers 429 +
+``Retry-After`` exactly like a full job queue."""
 
 from __future__ import annotations
 
 import json
 import os
+import time
 import zipfile
 from typing import Optional
 
+import numpy as np
 from jax.sharding import Mesh
 
 from learningorchestra_tpu.core.store import DocumentStore
@@ -29,12 +41,17 @@ from learningorchestra_tpu.ml.checkpoint import (
     checkpoint_path as _checkpoint_path,
 )
 from learningorchestra_tpu.sched import DEVICE_CLASS, QueueFullError
+from learningorchestra_tpu.serve import ModelNotFoundError, global_serve_plane
+from learningorchestra_tpu.serve.batcher import LATENCY_BUCKETS
 from learningorchestra_tpu.services import validators
 from learningorchestra_tpu.telemetry import register_store
 from learningorchestra_tpu.utils.web import WebApp, too_many_requests
 
 MESSAGE_RESULT = "result"
 MESSAGE_CREATED_FILE = "created_file"
+MESSAGE_INVALID_ROWS = "invalid_rows"
+MESSAGE_SERVE_TIMEOUT = "predict_timeout"
+MESSAGE_TOO_MANY_ROWS = "too_many_rows"
 
 
 def create_app(
@@ -44,12 +61,16 @@ def create_app(
     models_dir: Optional[str] = None,
     predict=None,
     jobs: "JobManager | None" = None,
+    serve=None,
 ) -> WebApp:
     """``build``/``predict`` override how a validated request body
     becomes a build_model / predict_with_model call — the multi-host
     runner injects an SPMD dispatch (parallel/spmd.py) so every process
     enters the fit; default is the in-process call. ``models_dir``
-    (default ``LO_MODELS_DIR``) is where checkpoints live.
+    (default ``LO_MODELS_DIR``) is where checkpoints live. ``serve``
+    injects a :class:`~learningorchestra_tpu.serve.ServePlane` (tests
+    pin knobs); default is the process-wide plane — safe across apps
+    because registry entries key on absolute checkpoint paths.
 
     Long builds: the reference keeps ``POST /models`` synchronous (201
     only after ALL fits, server.py:112-115) and that stays the default
@@ -80,6 +101,31 @@ def create_app(
 
     def checkpoint_path(name: str) -> str:
         return _checkpoint_path(models_dir, name)
+
+    # The online-serving plane (docs/serving.md). Constructed lazily so
+    # apps that never see predict traffic cost nothing; the default is
+    # process-wide (registry keys are absolute artifact paths).
+    plane_box: list = [serve]
+
+    def serve_plane():
+        if plane_box[0] is None:
+            plane_box[0] = global_serve_plane()
+        return plane_box[0]
+
+    from learningorchestra_tpu.serve import config as serve_config
+
+    # Fail-fast: resolve EVERY serving knob now, not at first request —
+    # a typo'd LO_SERVE_BYTES must break app construction (the posture
+    # deploy/run.sh preflights; library embedders get it here), never
+    # surface as a 500 on a live route.
+    serve_knobs = serve_config.validate_all()
+    serve_timeout_s = serve_knobs["request_timeout_s"]
+    serve_max_rows = serve_knobs["max_rows"]
+    serve_seconds = app.registry.histogram(
+        "lo_serve_request_seconds",
+        "End-to-end predict latency (admission to response build)",
+        buckets=LATENCY_BUCKETS,
+    )
 
     if build is None:
 
@@ -181,14 +227,17 @@ def create_app(
 
     @app.route("/models", methods=("GET",))
     def list_models(request):
+        # "result" stays the plain name list (clients and tests index
+        # it); registry occupancy rides alongside as "serving"
+        serving = serve_plane().stats()
         if not models_dir or not os.path.isdir(models_dir):
-            return {MESSAGE_RESULT: []}, 200
+            return {MESSAGE_RESULT: [], "serving": serving}, 200
         names = sorted(
             name[: -len(CHECKPOINT_SUFFIX)]
             for name in os.listdir(models_dir)
             if name.endswith(CHECKPOINT_SUFFIX)
         )
-        return {MESSAGE_RESULT: names}, 200
+        return {MESSAGE_RESULT: names, "serving": serving}, 200
 
     @app.route("/models/<model_name>", methods=("GET",))
     def get_model(request, model_name):
@@ -206,6 +255,71 @@ def create_app(
                 "name": model_name,
                 "kind": header["kind"],
                 "size_bytes": os.path.getsize(path),
+                "serving": serve_plane().registry.status(path),
+            }
+        }, 200
+
+    @app.route("/models/<model_name>/predict", methods=("POST",))
+    def predict_rows(request, model_name):
+        """The online lane: rows in, labels + probabilities out, one
+        synchronous response. Never a job record, never a traceback —
+        every failure mode maps to a JSON error body (404 unknown or
+        not-yet-built model, 406 malformed rows, 429 inbox full, 503
+        timed out, 500 a forward-pass failure with its message)."""
+        started = time.perf_counter()
+        if (
+            not models_dir
+            or not validators.safe_filename(model_name)
+            or not os.path.isfile(checkpoint_path(model_name))
+        ):
+            return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
+        body = request.get_json(silent=True)
+        if not isinstance(body, dict) or "rows" not in body:
+            return {MESSAGE_RESULT: validators.MESSAGE_MISSING_FIELDS}, 406
+        try:
+            rows = np.asarray(body["rows"], dtype=np.float32)
+        except (TypeError, ValueError):  # ragged / non-numeric
+            return {MESSAGE_RESULT: MESSAGE_INVALID_ROWS}, 406
+        if rows.ndim == 1 and rows.size:  # one bare row is one request
+            rows = rows.reshape(1, -1)
+        # np.isfinite also rejects JSON nulls: asarray converts None to
+        # NaN without raising, which would otherwise slip past the 406
+        # and come back as a 200 full of NaN "probabilities"
+        if rows.ndim != 2 or rows.size == 0 or not np.isfinite(rows).all():
+            return {MESSAGE_RESULT: MESSAGE_INVALID_ROWS}, 406
+        if len(rows) > serve_max_rows:
+            # the online lane is for low-latency scoring; bulk bodies
+            # belong on the batch lane (POST /models/<name>/predictions)
+            return {MESSAGE_RESULT: MESSAGE_TOO_MANY_ROWS}, 413
+        try:
+            pending = serve_plane().submit(checkpoint_path(model_name), rows)
+        except QueueFullError as error:  # bounded inbox: 429 parity
+            return too_many_requests(error)
+        done = pending.wait(serve_timeout_s)
+        # every post-dispatch exit is observed: a p99 that excluded the
+        # timed-out and failed requests would read healthy during the
+        # exact overload it exists to expose
+        serve_seconds.observe(time.perf_counter() - started)
+        if not done:
+            # tell the batcher not to run the forward for a client that
+            # stopped listening — the backlog drains instead of growing
+            pending.abandon()
+            return {MESSAGE_RESULT: MESSAGE_SERVE_TIMEOUT}, 503
+        if pending.error is not None:
+            if isinstance(pending.error, ModelNotFoundError):
+                # artifact deleted between the check above and dispatch
+                return {MESSAGE_RESULT: validators.MESSAGE_NOT_FOUND}, 404
+            return {
+                MESSAGE_RESULT: (
+                    "prediction_failed: "
+                    f"{type(pending.error).__name__}: {pending.error}"
+                )
+            }, 500
+        return {
+            MESSAGE_RESULT: {
+                "model": model_name,
+                "predictions": pending.labels.tolist(),
+                "probabilities": pending.probs.tolist(),
             }
         }, 200
 

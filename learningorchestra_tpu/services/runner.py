@@ -67,6 +67,13 @@ Environment:
   capacity (core/devcache.py; 0 disables), zlib compression on the
   binary store wire, and the builder's overlapped prediction
   write-back (0 restores synchronous writes).
+- ``LO_SERVE_BYTES`` / ``LO_SERVE_BATCH_WINDOW_MS`` / ``LO_SERVE_MAX_BATCH``
+  / ``LO_SERVE_MAX_ROWS`` / ``LO_SERVE_QUEUE_CAP`` / ``LO_SERVE_TIMEOUT_S``
+  — online-serving knobs (docs/serving.md): the model registry's
+  pinned-parameter byte budget (0 = host-only fallback), the
+  micro-batch collection window, the per-dispatch request cap, the
+  per-request row cap (413 past it), the bounded batcher inbox (429 +
+  Retry-After past it), and the per-request wait bound.
 - ``LO_INGEST_SLAB_BYTES`` — CSVs past this size parse as bounded slabs
   (core/ingest.py), keeping ingest's transient working set slab-sized.
 - ``LO_AUTO_PROMOTE_S`` / ``LO_PEERS`` / ``LO_FAILOVER_TIMEOUT_S`` —
@@ -338,6 +345,12 @@ def main() -> None:
     from learningorchestra_tpu.core.devcache import capacity_bytes
 
     print(f"devcache capacity: {capacity_bytes()} bytes", flush=True)
+
+    # Same fail-fast posture for the serving knobs: a typo'd
+    # LO_SERVE_BYTES must not silently serve at the default budget.
+    from learningorchestra_tpu.serve import config as serve_config
+
+    print(f"serving config: {serve_config.validate_all()}", flush=True)
 
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache

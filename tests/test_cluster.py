@@ -497,6 +497,65 @@ class TestReplicationManifest:
             load({"enabled": True, "sync_repl": 2})
 
 
+class TestServingManifest:
+    def test_serving_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["serving"] = {
+            "serve_bytes": 500_000_000,
+            "batch_window_ms": 2,
+            "max_batch": 32,
+            "max_rows": 2048,
+            "queue_cap": 128,
+            "timeout_s": 10,
+        }
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # every machine, like sched/dataplane knobs
+            env = plan["env"]
+            assert env["LO_SERVE_BYTES"] == "500000000"
+            assert env["LO_SERVE_BATCH_WINDOW_MS"] == "2"
+            assert env["LO_SERVE_MAX_BATCH"] == "32"
+            assert env["LO_SERVE_MAX_ROWS"] == "2048"
+            assert env["LO_SERVE_QUEUE_CAP"] == "128"
+            assert env["LO_SERVE_TIMEOUT_S"] == "10"
+
+    def test_serving_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(serving):
+            manifest = _manifest()
+            manifest["serving"] = serving
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # 0 bytes = host-only fallback, 0 ms window: both valid
+        loaded = load({"serve_bytes": 0, "batch_window_ms": 0})
+        assert loaded["serving"]["serve_bytes"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"serve_bytes": -1})
+        with pytest.raises(SystemExit):
+            load({"serve_bytes": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"serve_bytes": "1e9"})
+        with pytest.raises(SystemExit):
+            load({"batch_window_ms": -0.5})
+        with pytest.raises(SystemExit):
+            load({"max_batch": 0})
+        with pytest.raises(SystemExit):
+            load({"max_batch": 1.5})  # request counts are integers
+        with pytest.raises(SystemExit):
+            load({"max_rows": 0})
+        with pytest.raises(SystemExit):
+            load({"queue_cap": 0})
+        with pytest.raises(SystemExit):
+            load({"timeout_s": 0})
+
+
 class TestMetricsScrape:
     def test_parse_prometheus_sums_families(self):
         cluster = _load_cluster_module()

@@ -41,6 +41,7 @@ def test_pip_install_provides_reference_client_surface(tmp_path):
         "    cls()\n"
         "assert DatabaseApi.DATABASE_API_PORT == '5000'\n"
         "assert Model.MODEL_BUILDER_PORT == '5002'\n"
+        "assert callable(Model.predict) and callable(Model.list_models)\n"
         "print('client surface ok')\n"
     )
     env = dict(os.environ)
