@@ -51,6 +51,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -273,6 +274,21 @@ def bench_kernels(X, y) -> dict:
     # as earlier rounds; one fewer repeat to fit the bench budget — a
     # min over fewer repeats can only read slower, never flatter).
     suite_time = _best_of(suite, repeats=2)
+    # Attribution overhead: the SAME suite with timeline recording on
+    # (an active trace + one span per kernel; sampler off). The flight
+    # recorder's contract is <2% overhead on kernel throughput — this
+    # measures it every round so a creeping instrumentation cost is a
+    # flagged regression, not a silent tax (docs/profiling.md).
+    from learningorchestra_tpu.telemetry import tracing as _tracing
+
+    def suite_recording():
+        trace_obj = _tracing.Trace(name="bench_kernels")
+        with _tracing.activate(trace_obj):
+            for name, kernel in kernels.items():
+                with _tracing.span(f"kernel:{name}"):
+                    kernel()
+
+    recording_time = _best_of(suite_recording, repeats=2)
     # Diagnostics: one timed pass per kernel (these sum lower than the
     # suite — they lose cross-kernel async overlap; don't compare across
     # rounds).
@@ -286,6 +302,11 @@ def bench_kernels(X, y) -> dict:
         "suite_s": round(suite_time, 4),
         "rows_per_sec": round(rows / suite_time, 1),
         "per_classifier_s": per_classifier,
+        "suite_recording_on_s": round(recording_time, 4),
+        # positive = recording cost; small negatives are run-to-run noise
+        "recording_overhead_pct": round(
+            100.0 * (recording_time / suite_time - 1.0), 2
+        ),
     }
     # Bytes-based rooflines for every kernel class: these tabular fits
     # are HBM-bound, so achieved GB/s against the chip's ceiling is the
@@ -450,11 +471,25 @@ def bench_product(X, y) -> dict:
     # skipped the wire read (host-table hits) and the H2D
     # (content-addressed device-matrix hits) — the per-revision
     # once-per-boundary contract docs/dataplane.md states.
+    # Cache-warm section runs under an active trace: the flight
+    # recorder's per-phase attribution (load/preprocess/h2d/fit/write
+    # seconds + wire/H2D bytes) is reported per round, so `--compare`
+    # can name the phase that moved when warm_s regresses.
+    from learningorchestra_tpu.telemetry import profile as _profile
+    from learningorchestra_tpu.telemetry import tracing as _tracing
+
     before_warm = global_devcache().stats()
+    warm_trace = _tracing.Trace(name="bench_product_warm")
     start = time.perf_counter()
-    results = run()
+    with _tracing.activate(warm_trace):
+        results = run()
     warm_s = time.perf_counter() - start  # what a steady-state request costs
     devcache_warm = devcache_delta(before_warm)
+    warm_summary = _profile.trace_summary(warm_trace)
+    warm_phases = {
+        name: entry["seconds"]
+        for name, entry in sorted(warm_summary["phases"].items())
+    }
     phases = {
         r["classificator"]: r["timings"] for r in results
     }
@@ -469,6 +504,7 @@ def bench_product(X, y) -> dict:
         "warm_speedup_vs_cold": round(cold_s / warm_s, 2),
         "devcache_cold": devcache_cold,
         "devcache_warm": devcache_warm,
+        "warm_attribution_s": warm_phases,
         "per_classifier_phases_s": phases,
         "accuracy": {
             r["classificator"]: float(r["accuracy"]) for r in results
@@ -596,13 +632,33 @@ def bench_embeddings() -> dict:
             continue
         X_big = blobs(rows)
         entry = _pca_timings(X_big)
-        run_tsne = lambda: tsne_embedding(X_big)  # noqa: E731 — landmark path
+        # Each landmark run records its own trace; the LAST run's phase
+        # split (landmark_fit vs interpolate vs d2h, ops/tsne.py spans)
+        # is reported so a regression localizes to the phase that moved
+        # — the attribution BENCH_r03→r05's tsne_landmark delta lacked.
+        from learningorchestra_tpu.telemetry import profile as _profile
+        from learningorchestra_tpu.telemetry import tracing as _tracing
+
+        traces: list = []
+
+        def run_tsne():
+            trace_obj = _tracing.Trace(name=f"tsne_{rows}")
+            traces.append(trace_obj)
+            with _tracing.activate(trace_obj):
+                return tsne_embedding(X_big)
+
         start = time.perf_counter()
         run_tsne()
         tsne_cold = time.perf_counter() - start
         warm_affordable = _budget_left() > 1.5 * tsne_cold
         tsne_s = _best_of(run_tsne, repeats=1) if warm_affordable else tsne_cold
         entry["tsne_landmark_s"] = round(tsne_s, 3)
+        phase_split = _profile.trace_summary(traces[-1])["phases"]
+        entry["tsne_phases_s"] = {
+            name.split(":", 1)[1]: phase["seconds"]
+            for name, phase in sorted(phase_split.items())
+            if name.startswith(("tsne:", "d2h:"))
+        }
         if not warm_affordable:
             entry["tsne_landmark_note"] = "cold_incl_compile"
         if RUN_SKLEARN:
@@ -755,7 +811,185 @@ def bench_mfu() -> dict:
     }
 
 
-def main() -> None:
+# --- regression gate (--compare) ---------------------------------------------
+# The machinery that would have caught and localized the tsne_landmark
+# regression the day it happened: diff every reported metric and
+# per-phase attribution against a prior run's record, flag any
+# regression past the threshold WITH the metric/phase that moved, and
+# exit non-zero so CI fails the round instead of archiving the loss.
+
+# suffixes that say which direction is "worse" for a dotted metric path
+_HIGHER_IS_BETTER = (
+    "rows_per_sec", "per_s", "predictions_per_s", "speedup", "mfu",
+    "gb_per_s", "vs_baseline", "accuracy", "trustworthiness",
+    "mean_batch_size",
+)
+_LOWER_IS_BETTER = ("_s", "_ms", "seconds", "p50_ms", "p99_ms")
+# numeric facts that are not performance (never gated, still diffed)
+_UNGATED = (
+    "rows", "bytes", "features", "budget", "hits", "misses", "entries",
+    "evictions", "invalidations", "components", "n_neighbors",
+    "subsample", "requests_per_client", "rows_per_request", "landmarks",
+    "macro_rows", "count", "depth", "capacity", "models", "peak",
+    "flops", "value", "rejected", "samples", "hz", "overhead_pct",
+)
+# absolute floor below which a time-like delta is timer noise, not a
+# regression (0.011s "doubling" to 0.022s must not fail a round). The
+# floor is applied in the metric's OWN unit: 50 ms for *_ms metrics
+# (p50_ms jittering 1.2 -> 1.8 ms is the same noise class).
+_SECONDS_FLOOR = 0.05
+
+
+def _noise_floor(path: str) -> float:
+    """The absolute delta a 'down' metric must move to count as a
+    regression, in the metric's own unit (leaf-first, like direction)."""
+    for segment in reversed(path.split(".")):
+        if segment.endswith("_ms"):
+            return _SECONDS_FLOOR * 1000.0
+        if segment.endswith("_s") or segment.endswith("seconds"):
+            return _SECONDS_FLOOR
+    return _SECONDS_FLOOR
+
+
+def _metric_direction(path: str):
+    """'up' (higher better), 'down' (lower better), or None (ungated).
+
+    Walks segments leaf-first so the most specific name wins: the leaf
+    decides when it carries a unit (``warm_s`` → down,
+    ``rows_per_sec`` → up, ``hits`` → ungated), and a unit-less leaf
+    inherits from its container — ``per_classifier_phases_s.lr.fit``
+    gates downward because the ``_s`` dict names the unit for every
+    phase inside it."""
+    for segment in reversed(path.split(".")):
+        # rate names first: "rows_per_sec" must gate up, not be eaten
+        # by the "rows" fact token below
+        for token in _HIGHER_IS_BETTER:
+            if token in segment:
+                return "up"
+        for token in _UNGATED:
+            if (
+                segment == token
+                or segment.startswith(token + "_")
+                or segment.endswith("_" + token)
+            ):
+                return None
+        if segment.endswith(_LOWER_IS_BETTER):
+            return "down"
+    return None
+
+
+def flatten_metrics(record, prefix: str = "") -> dict:
+    """Every numeric leaf of a bench record as ``dotted.path: value``."""
+    out: dict[str, float] = {}
+    if isinstance(record, dict):
+        for key, value in record.items():
+            out.update(flatten_metrics(value, f"{prefix}{key}."))
+    elif isinstance(record, (int, float)) and not isinstance(record, bool):
+        out[prefix[:-1]] = float(record)
+    return out
+
+
+def load_bench_record(path: str) -> dict:
+    """A bench record from any of the shapes this repo archives: the
+    driver's ``{"tail": ...}`` capture (BENCH_rNN.json — the record is
+    the last ``{"metric": ...}`` line), a raw bench stdout record, or a
+    BENCH_EXTRA sidecar (wrapped as the record's ``extra``)."""
+    with open(path) as handle:
+        data = json.load(handle)
+    if isinstance(data, dict) and "tail" in data and "metric" not in data:
+        record = None
+        for line in data["tail"].splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    record = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        if record is None:
+            raise ValueError(f"no bench record line in {path!r}")
+        return record
+    if isinstance(data, dict) and "metric" not in data:
+        return {"extra": data}  # a BENCH_EXTRA sidecar
+    return data
+
+
+def compare_benchmarks(
+    previous: dict, current: dict, threshold: float = 0.25
+) -> dict:
+    """Diff two bench records. Returns ``{"diffs", "regressions",
+    "improvements"}``: diffs cover every shared numeric metric;
+    regressions are direction-gated changes worse by more than
+    ``threshold`` (relative) AND past the absolute noise floor for
+    seconds-like metrics — each names the exact metric/phase that
+    moved."""
+    prev_flat = flatten_metrics(previous)
+    cur_flat = flatten_metrics(current)
+    diffs, regressions, improvements = [], [], []
+    for path in sorted(prev_flat.keys() & cur_flat.keys()):
+        prev_value, cur_value = prev_flat[path], cur_flat[path]
+        if prev_value == cur_value:
+            continue
+        change = (
+            (cur_value - prev_value) / abs(prev_value)
+            if prev_value
+            else float("inf") if cur_value else 0.0
+        )
+        entry = {
+            "metric": path,
+            "previous": prev_value,
+            "current": cur_value,
+            "change_pct": round(change * 100.0, 1),
+        }
+        diffs.append(entry)
+        direction = _metric_direction(path)
+        if direction is None:
+            continue
+        worse = change > threshold if direction == "down" else (
+            change < -threshold
+        )
+        if worse and direction == "down":
+            # timer-noise floor, in the metric's own unit (s vs ms)
+            if abs(cur_value - prev_value) < _noise_floor(path):
+                worse = False
+        if worse:
+            regressions.append(entry)
+        elif (change < -threshold if direction == "down" else change > threshold):
+            improvements.append(entry)
+    return {
+        "diffs": diffs,
+        "regressions": regressions,
+        "improvements": improvements,
+        "threshold_pct": round(threshold * 100.0, 1),
+    }
+
+
+def print_comparison(result: dict, previous_path: str) -> None:
+    """Human-readable per-metric report. Goes BEFORE the headline JSON
+    line so the driver's last-line record stays parseable."""
+    print(f"--- bench compare vs {previous_path} "
+          f"(threshold {result['threshold_pct']}%) ---")
+    for entry in result["diffs"]:
+        marker = " "
+        if entry in result["regressions"]:
+            marker = "R"
+        elif entry in result["improvements"]:
+            marker = "+"
+        print(
+            f"{marker} {entry['metric']}: {entry['previous']} -> "
+            f"{entry['current']} ({entry['change_pct']:+}%)"
+        )
+    if result["regressions"]:
+        print(f"REGRESSIONS ({len(result['regressions'])}):")
+        for entry in result["regressions"]:
+            print(
+                f"  {entry['metric']}: {entry['previous']} -> "
+                f"{entry['current']} ({entry['change_pct']:+}%)"
+            )
+    else:
+        print("no regressions past threshold")
+
+
+def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
     # Persistent XLA compile cache (the product runs with it too,
     # services/runner.py): every timed number here is a warm best-of
     # measurement, so caching compiles only stops setup time from
@@ -843,19 +1077,59 @@ def main() -> None:
             for key in ("pca_e2e_numpy_s", "tsne_landmark_s"):
                 if key in at_scale:
                     summary[key] = at_scale[key]
-    print(
-        json.dumps(
-            {
-                "metric": "model_builder_5clf_rows_per_sec",
-                "value": rows_per_sec,
-                "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 1),
-                "summary": summary,
-                "extra_file": extra_path,
-            }
+    record = {
+        "metric": "model_builder_5clf_rows_per_sec",
+        "value": rows_per_sec,
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 1),
+        "summary": summary,
+        "extra_file": extra_path,
+    }
+    exit_code = 0
+    if compare_path is not None:
+        # the comparison sees the FULL extra payload (per-phase
+        # attribution included), not just the compact summary line
+        comparison = compare_benchmarks(
+            load_bench_record(compare_path),
+            {**record, "extra": extra},
+            threshold=threshold,
         )
-    )
+        print_comparison(comparison, compare_path)
+        if comparison["regressions"]:
+            exit_code = 1
+    # headline record LAST: the driver parses the final stdout line
+    print(json.dumps(record))
+    return exit_code
+
+
+def cli(argv: Optional[list] = None) -> int:
+    """``python bench.py [--compare PREV.json [--current CUR.json]]``.
+
+    ``--compare`` alone runs the benchmark and diffs its record (with
+    the full per-phase attribution) against the prior run's archived
+    JSON; with ``--current`` no benchmark runs — the two files are
+    compared directly (the CI fixture mode the regression-gate tests
+    drive). Exit status 1 when any gated metric regressed past
+    ``--threshold`` (default 0.25 = 25%)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=cli.__doc__)
+    parser.add_argument("--compare", metavar="PREV_JSON", default=None)
+    parser.add_argument("--current", metavar="CUR_JSON", default=None)
+    parser.add_argument("--threshold", type=float, default=0.25)
+    args = parser.parse_args(argv)
+    if args.current is not None:
+        if args.compare is None:
+            parser.error("--current requires --compare")
+        comparison = compare_benchmarks(
+            load_bench_record(args.compare),
+            load_bench_record(args.current),
+            threshold=args.threshold,
+        )
+        print_comparison(comparison, args.compare)
+        return 1 if comparison["regressions"] else 0
+    return main(compare_path=args.compare, threshold=args.threshold)
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(cli())

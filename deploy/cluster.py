@@ -59,6 +59,10 @@ Manifest (JSON)::
         "queue_cap": 256,          #   LO_SERVE_QUEUE_CAP (429 past it)
         "timeout_s": 30            #   LO_SERVE_TIMEOUT_S (> 0)
       },
+      "profiling": {               # optional flight-recorder knobs
+        "prof_hz": 47,             #   LO_PROF_HZ (0 disables /debug/
+        "prof_window_s": 60        #   profile); LO_PROF_WINDOW_S (> 0)
+      },
       "replication": {             # optional replicated store plane
         "enabled": true,           #   (docs/replication.md): the head
         "follower_port": 27028,    #   runs primary + WAL-shipping
@@ -177,6 +181,25 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("serving.timeout_s must be > 0")
         elif value < 1:
             raise SystemExit(f"serving.{key} must be >= 1")
+    profiling = manifest.setdefault("profiling", {})
+    for key in profiling:
+        if key not in _PROFILING_KNOBS:
+            raise SystemExit(
+                f"unknown profiling knob {key!r} (have: "
+                f"{', '.join(sorted(_PROFILING_KNOBS))})"
+            )
+        value = profiling[key]
+        # same bool-is-int trap as the sched knobs
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SystemExit(f"profiling.{key} must be a number")
+        if key == "prof_hz":
+            if not isinstance(value, int) or value < 0:
+                raise SystemExit(
+                    "profiling.prof_hz must be an integer >= 0 "
+                    "(0 disables /debug/profile)"
+                )
+        elif key == "prof_window_s" and value <= 0:
+            raise SystemExit("profiling.prof_window_s must be > 0")
     replication = manifest.setdefault("replication", {})
     for key in replication:
         if key not in _REPLICATION_KNOBS:
@@ -247,6 +270,15 @@ _SERVING_KNOBS = {
     "timeout_s": "LO_SERVE_TIMEOUT_S",
 }
 
+# manifest profiling.<knob> -> the env var every machine receives
+# (docs/profiling.md). Cluster-wide: a stall diagnosis must be able to
+# hit /debug/profile on ANY member, so no machine may silently run with
+# the profiler knocked out or a different window cap.
+_PROFILING_KNOBS = {
+    "prof_hz": "LO_PROF_HZ",
+    "prof_window_s": "LO_PROF_WINDOW_S",
+}
+
 # manifest replication.<knob> (docs/replication.md); the head machine
 # runs the whole store plane, every machine's LO_STORE_URL names the
 # primary AND the follower for client-side failover
@@ -302,6 +334,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _SERVING_KNOBS.items():
         if knob in manifest.get("serving", {}):
             shared[env_var] = str(manifest["serving"][knob])
+    for knob, env_var in _PROFILING_KNOBS.items():
+        if knob in manifest.get("profiling", {}):
+            shared[env_var] = str(manifest["profiling"][knob])
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 

@@ -44,6 +44,14 @@
 #                            get HTTP 429 + Retry-After    (default 256)
 #   LO_SERVE_TIMEOUT_S       per-request wait bound → 503  (default 30)
 #
+# Profiling knobs (docs/profiling.md has the full table):
+#   LO_PROF_HZ            sampling-profiler rate for GET /debug/profile
+#                         (default 47; 0 disables the endpoint — the
+#                         sampler never runs outside an explicit request
+#                         either way)
+#   LO_PROF_WINDOW_S      longest window one /debug/profile request may
+#                         sample (default 60; must be > 0)
+#
 # Replication / failover knobs (docs/replication.md has the full table):
 #   LO_REPLICATION        1 = replicated store plane (primary + follower
 #                         + quorum arbiter) when run under deploy/stack.py
@@ -77,6 +85,9 @@ devcache.capacity_bytes()
 # (window >= 0, bytes >= 0 with 0 = host-only fallback)
 from learningorchestra_tpu.serve import config as serve_config
 serve_config.validate_all()
+# profiling knobs: HZ >= 0 (0 = /debug/profile disabled), window > 0
+from learningorchestra_tpu.telemetry import profile as lo_profile
+lo_profile.validate_env()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
              "LO_STORE_SYNC_REPL"):
     value = os.environ.get(knob, "").strip()

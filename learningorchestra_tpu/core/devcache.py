@@ -373,13 +373,27 @@ def dataset_table(store, collection: str, fields=None, cache=None):
     verbs copy-on-write), which is the same contract the per-frame
     device cache already relies on."""
     from learningorchestra_tpu.core.table import ColumnTable
+    from learningorchestra_tpu.telemetry import span
 
     cache = cache or global_devcache()
+
+    def load():
+        # store:read wraps the whole store→host materialization (local
+        # or remote backend; a RemoteStore nests its wire:read inside)
+        # with rows + decoded host bytes, so the timeline attributes
+        # the host-boundary cost even when no wire is involved.
+        with span("store:read", collection=collection) as span_obj:
+            table = ColumnTable.from_store(store, collection, fields)
+            if span_obj is not None:
+                span_obj.meta["rows"] = table.num_rows
+                span_obj.meta["bytes"] = _table_nbytes(table)
+            return table
+
     return cache.get_or_load(
         store,
         collection,
         ("table", _fields_key(fields)),
-        lambda: ColumnTable.from_store(store, collection, fields),
+        load,
         _table_nbytes,
     )
 
@@ -402,7 +416,12 @@ def dataset_embedding_inputs(store, collection: str, mesh=None, cache=None):
         table = dataset_table(store, collection, cache=cache).dropna()
         encoded, vocabularies = table.encoded()
         X = encoded.matrix()
-        with span("h2d:dataset", collection=collection, rows=len(X)):
+        # h2d byte accounting happens inside shard_matrix (the
+        # shard_rows funnel, parallel/sharding.py) and accumulates onto
+        # this span as h2d_bytes
+        with span(
+            "h2d:dataset", collection=collection, rows=len(X), dtype="f32"
+        ):
             return encoded, vocabularies, shard_matrix(X, mesh)
 
     return cache.get_or_load(
@@ -440,7 +459,7 @@ def content_device_matrix(X: np.ndarray, mesh):
     cached = cache.get(CONTENT, CONTENT, subkey, rev=0)
     if cached is not None:
         return cached
-    with span("h2d:matrix", rows=len(X)):
+    with span("h2d:matrix", rows=len(X), dtype="f32"):
         dm = shard_matrix(X, mesh)
     return cache.put(
         CONTENT, CONTENT, subkey, 0, dm, _device_matrix_nbytes(dm)
@@ -457,6 +476,6 @@ def content_device_labels(y: np.ndarray, mesh):
     cached = cache.get(CONTENT, CONTENT, subkey, rev=0)
     if cached is not None:
         return cached
-    with span("h2d:labels", rows=len(y)):
+    with span("h2d:labels", rows=len(y), dtype="i32"):
         dl = shard_labels(y, mesh)
     return cache.put(CONTENT, CONTENT, subkey, 0, dl, int(dl.data.nbytes))

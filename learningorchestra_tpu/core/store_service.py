@@ -102,6 +102,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Iterator, Optional
 
 import requests
@@ -114,6 +115,8 @@ from learningorchestra_tpu.core.store import (
     InMemoryStore,
     UnsupportedQueryError,
 )
+from learningorchestra_tpu.telemetry import profile as _profile
+from learningorchestra_tpu.telemetry import tracing as _tracing
 from learningorchestra_tpu.testing import faults
 from learningorchestra_tpu.core.wire import (
     ACCEPT_HEADER,
@@ -954,6 +957,11 @@ class RemoteStore(DocumentStore):
         verify=None,
     ) -> dict:
         headers = {"Content-Type": BIN_CONTENT_TYPE}
+        if collection is not None:
+            # flight-recorder attribution: payload bytes (pre-compression
+            # — the decode-side cost a reader will pay) into
+            # lo_wire_bytes_total and the ambient span (profile.py)
+            _profile.account_wire("write", collection, len(frame))
         if self.compress and len(frame) >= COMPRESS_MIN_BYTES:
             frame = compress_frame(frame)
             headers[ENCODING_HEADER] = WIRE_COMPRESSION
@@ -1099,6 +1107,16 @@ class RemoteStore(DocumentStore):
         num_rows = lengths.pop() if lengths else 0
         if not columns:
             return
+        with _tracing.span("wire:write", collection=collection, rows=num_rows):
+            self._insert_column_arrays(collection, columns, num_rows, start_id)
+
+    def _insert_column_arrays(
+        self,
+        collection: str,
+        columns: dict[str, Column],
+        num_rows: int,
+        start_id: Optional[int],
+    ) -> None:
         stride = self.wire_rows_bin
         for offset in range(0, max(num_rows, 1), stride):
             stop = min(offset + stride, num_rows)
@@ -1178,6 +1196,7 @@ class RemoteStore(DocumentStore):
                     {field: column.slice(offset, stop)},
                     extra={"field": field, "start_id": start_id + offset},
                 ),
+                collection=collection,
             )
             if stop >= len(column):
                 break
@@ -1303,8 +1322,6 @@ class RemoteStore(DocumentStore):
                 if attempt >= self.chunk_retries:
                     raise
                 attempt += 1
-                import time
-
                 time.sleep(min(0.2 * attempt, 1.0))
 
     def _decode_chunk(
@@ -1340,6 +1357,29 @@ class RemoteStore(DocumentStore):
         start: int,
         limit: Optional[int],
         check_rev: bool = True,
+    ) -> tuple[dict[str, Column], bool]:
+        # wire:read wraps the whole paged read: account_wire/
+        # account_decode inside the chunk loop accumulate wire_bytes +
+        # decode_s onto THIS span (fetches run on helper threads, but
+        # the bytes are counted where they are consumed — here), so the
+        # job timeline carries the read's full byte-and-decode bill.
+        with _tracing.span("wire:read", collection=collection) as span_obj:
+            out, torn = self._paged_read(
+                collection, fields, start, limit, check_rev
+            )
+            if span_obj is not None:
+                span_obj.meta["rows"] = max(
+                    (len(c) for c in out.values()), default=0
+                )
+        return out, torn
+
+    def _paged_read(
+        self,
+        collection: str,
+        fields: Optional[list[str]],
+        start: int,
+        limit: Optional[int],
+        check_rev: bool,
     ) -> tuple[dict[str, Column], bool]:
         out: dict[str, Column] = {}
         fetched = 0
@@ -1397,8 +1437,13 @@ class RemoteStore(DocumentStore):
                         next_start,
                         next_limit,
                     )
+                _profile.account_wire("read", collection, len(raw))
+                decode_started = time.perf_counter()
                 columns, extra = self._decode_chunk(
                     collection, fields, chunk_start, chunk_limit, raw
+                )
+                _profile.account_decode(
+                    collection, time.perf_counter() - decode_started
                 )
                 chunk_rev = extra.get("rev", -1)
                 if rev is None:

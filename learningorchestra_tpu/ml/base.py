@@ -200,6 +200,7 @@ class FittedModel:
         a ``d2h`` span in the active trace (a no-op outside one), so the
         device→host tail shows up in ``/jobs/<name>/trace`` next to the
         ``h2d`` spans the data plane emits."""
+        from learningorchestra_tpu.telemetry import profile as _profile
         from learningorchestra_tpu.telemetry import span as _span
 
         with _span("d2h:predictions", rows=n):
@@ -213,12 +214,15 @@ class FittedModel:
                     else np.asarray(fetch(labels))[:n]
                 )
                 fetched = jax.device_get(tuple(scalars)) if scalars else ()
+                _profile.account_d2h(probs_np.nbytes + labels_np.nbytes)
                 return labels_np, probs_np, tuple(fetched)
             if self.labels_from_probs:
                 out = jax.device_get((probs,) + tuple(scalars))
                 probs_np = np.asarray(out[0])[:n]
+                _profile.account_d2h(probs_np.nbytes)
                 return np.argmax(probs_np, axis=1), probs_np, tuple(out[1:])
             out = jax.device_get((labels, probs) + tuple(scalars))
+            _profile.account_d2h(out[0].nbytes + out[1].nbytes)
             return (
                 np.asarray(out[0])[:n],
                 np.asarray(out[1])[:n],

@@ -202,7 +202,7 @@ def train_one(
     y_train = features_training.label_vector(LABEL_COL)
 
     classifier = make_classifier(classificator_name, mesh=mesh)
-    with timer.phase("fit"):
+    with timer.phase("fit", rows=len(X_train), dtype="f32"):
         model = classifier.fit(X_train, y_train)
         # drain the async dispatch queue inside the fit phase: without
         # this the device time lands on whichever later call blocks
@@ -251,7 +251,7 @@ def train_one(
         X_eval = features_evaluation.device_matrix(FEATURES_COL, model.mesh)
         y_eval = features_evaluation.device_labels(LABEL_COL, model.mesh)
         X_test = features_testing.device_matrix(FEATURES_COL, model.mesh)
-        with timer.phase("evaluate"):
+        with timer.phase("evaluate", rows=features_evaluation.count()):
             accuracy, weighted_f1, labels, probs = model.evaluate_predict(
                 X_eval, y_eval, X_test
             )
@@ -307,7 +307,7 @@ def _predict_and_write(
     """
     if prediction is None:  # no eval split: predict is its own pass
         X_test = features_testing.device_matrix(FEATURES_COL, model.mesh)
-        with timer.phase("predict"):
+        with timer.phase("predict", rows=features_testing.count()):
             # one forward pass yields labels AND probabilities
             prediction = model.predict_both(X_test)
     labels, probability = prediction
@@ -320,10 +320,14 @@ def _predict_and_write(
         return metadata
 
     columns = _prediction_columns(predicted_df)
+    write_rows = predicted_df.count()
+    write_bytes = sum(
+        int(column.resident_nbytes()) for column in columns.values()
+    )
 
     def flush() -> None:
         store.drop(output_name)
-        with timer.phase("write"):
+        with timer.phase("write", rows=write_rows, bytes=write_bytes):
             insert_columns_batched(store, output_name, columns)
         metadata["timings"] = timer.as_metadata()
         store.insert_one(output_name, metadata)
@@ -389,8 +393,10 @@ def build_model(
     with _tracing.span("load_data"):
         training_df = load_dataframe(store, training_filename)
         testing_df = load_dataframe(store, test_filename)
+        _tracing.annotate(rows=training_df.count() + testing_df.count())
     with _tracing.span("preprocess"):
         out = run_preprocessor(preprocessor_code, training_df, testing_df)
+        _tracing.annotate(rows=out["features_training"].count())
         out["features_evaluation"] = _alias_if_equal(
             out["features_evaluation"], out["features_testing"]
         )

@@ -57,10 +57,16 @@ def pca_embedding(
     ZERO host↔device input traffic — ``prepare_xy`` passes its buffers
     straight through and only the ``(rows, n_components)`` embedding
     crosses back (the ``d2h`` span below is the whole transfer bill)."""
-    from learningorchestra_tpu.telemetry import span
+    from learningorchestra_tpu.telemetry import profile, span
 
     mesh = resolve_mesh(mesh)
-    X_dev, _, mask = prepare_xy(X, None, mesh)
-    embedded, _, _ = _pca(X_dev, mask, n_components)
+    # prepare = H2D (when X is a host array) + the async fit dispatch;
+    # the device compute itself is awaited inside the d2h span below,
+    # which is where its wall-clock lands on the timeline.
+    with span("pca:prepare", rows=len(X)):
+        X_dev, _, mask = prepare_xy(X, None, mesh)
+        embedded, _, _ = _pca(X_dev, mask, n_components)
     with span("d2h:pca", rows=len(X), components=n_components):
-        return fetch(embedded)[: len(X)]
+        out = fetch(embedded)[: len(X)]
+        profile.account_d2h(int(np.asarray(out).nbytes))
+        return out

@@ -303,10 +303,12 @@ def _tsne_exact_on_device(
         jnp.float32(learning_rate),
         jnp.float32(EARLY_EXAGGERATION),
     )
-    from learningorchestra_tpu.telemetry import span
+    from learningorchestra_tpu.telemetry import profile, span
 
     with span("d2h:tsne", rows=n):
-        return fetch(Y)[:n]
+        out = fetch(Y)[:n]
+        profile.account_d2h(int(np.asarray(out).nbytes))
+        return out
 
 
 @partial(jax.jit, static_argnames=("mesh", "chunk"))
@@ -347,12 +349,20 @@ def _tsne_landmark(
     seed: int,
     landmarks: int,
 ) -> np.ndarray:
+    from learningorchestra_tpu.telemetry import span
+
     n = len(X)
     rng = np.random.default_rng(seed)
     m = min(landmarks, n)
     chosen = rng.choice(n, size=m, replace=False)
     L = X[chosen]
-    Y_L = _tsne_exact(L, mesh, perplexity, iterations, learning_rate, seed)
+    # Phase spans: the landmark path is (exact fit on m rows) +
+    # (interpolate n rows); each phase ends in a blocking fetch, so
+    # these wall-clocks are honest — they are the attribution that
+    # localizes a landmark-path regression to the phase that moved
+    # (bench.py reports them per run; --compare diffs them).
+    with span("tsne:landmark_fit", rows=m):
+        Y_L = _tsne_exact(L, mesh, perplexity, iterations, learning_rate, seed)
     if m == n:
         # Every row IS a landmark: the exact embedding is already the
         # answer — undo the sampling permutation instead of blurring it
@@ -388,16 +398,20 @@ def _tsne_landmark(
         macro = max(
             multiple, (_INTERP_ROWS_PER_PROGRAM // multiple) * multiple
         )
-    outs = []
-    for start in range(0, n, macro):
-        stop = min(start + macro, n)
-        block = X[start:stop]
-        padded = np.pad(block, ((0, macro - len(block)), (0, 0)))
-        X_dev = jax.device_put(jnp.asarray(padded), row_sharded)
-        Y = _interpolate(
-            mesh, X_dev, L_dev, Y_L_dev, jnp.float32(interp_perplexity), chunk
-        )
-        outs.append(np.asarray(fetch(Y))[: len(block)])
+    with span(
+        "tsne:interpolate", rows=n, landmarks=m, macro_rows=macro
+    ):
+        outs = []
+        for start in range(0, n, macro):
+            stop = min(start + macro, n)
+            block = X[start:stop]
+            padded = np.pad(block, ((0, macro - len(block)), (0, 0)))
+            X_dev = jax.device_put(jnp.asarray(padded), row_sharded)
+            Y = _interpolate(
+                mesh, X_dev, L_dev, Y_L_dev, jnp.float32(interp_perplexity),
+                chunk,
+            )
+            outs.append(np.asarray(fetch(Y))[: len(block)])
     return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
 

@@ -99,6 +99,13 @@ def shard_rows(
     if dtype is not None:
         padded = padded.astype(dtype)
     sharding = row_sharded(mesh)
+    # Flight-recorder byte accounting at THE H2D funnel (every matrix/
+    # label transfer in the product path comes through here): counts
+    # into lo_h2d_bytes_total and the ambient span. Host-side only —
+    # identical on every process, no collective, SPMD-safe.
+    from learningorchestra_tpu.telemetry import profile
+
+    profile.account_h2d(int(padded.nbytes) + int(mask.nbytes))
     return (
         jax.device_put(padded, sharding),
         jax.device_put(mask, sharding),

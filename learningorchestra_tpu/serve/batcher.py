@@ -37,8 +37,14 @@ from typing import Optional
 import numpy as np
 
 from learningorchestra_tpu.sched.scheduler import QueueFullError
+from learningorchestra_tpu.telemetry import tracing as _tracing
 
 SERVE_CLASS = "serve"
+
+# One forward in TRACE_EVERY runs under its own trace, remembered in
+# tracing's bounded in-process ring (remember_trace, 256 entries): the
+# serving lane's timeline evidence without per-request trace cost.
+TRACE_EVERY = 16
 
 _CLOSE = object()  # inbox sentinel
 
@@ -100,10 +106,14 @@ class MicroBatcher:
         window_s: Optional[float] = None,
         max_batch: Optional[int] = None,
         inbox_cap: Optional[int] = None,
+        trace_every: int = TRACE_EVERY,
     ):
         from learningorchestra_tpu.serve import config
 
         self.registry = registry
+        # sample 1-in-N forwards into the bounded trace ring (0 = off;
+        # tests pass 1 to trace every dispatch)
+        self.trace_every = trace_every
         self.window_s = config.batch_window_s() if window_s is None else window_s
         self.max_batch = config.max_batch() if max_batch is None else max_batch
         cap = config.queue_cap() if inbox_cap is None else inbox_cap
@@ -237,26 +247,57 @@ class MicroBatcher:
             )
 
     def _forward(self, group: list) -> None:
+        import contextlib
+
         from learningorchestra_tpu.telemetry import span
 
+        # The worker thread runs outside any request context, so by
+        # default span() is a no-op here. Sample 1-in-trace_every
+        # forwards into their own trace, parked in the bounded
+        # in-process ring (remember_trace) — the serving lane's
+        # flight-recorder evidence: batch rows/bytes and the registry
+        # hit/miss verdict ride the serve:forward span.
+        trace = None
+        if self.trace_every and self.batches % self.trace_every == 0:
+            trace = _tracing.Trace(name=f"serve:{group[0].path}")
+        context = (
+            _tracing.activate(trace)
+            if trace is not None
+            else contextlib.nullcontext()
+        )
         try:
-            model = self.registry.get(group[0].path)
-            rows = np.concatenate([request.rows for request in group])
-            total = len(rows)
-            if total < self.max_batch:
-                # fixed dispatch shape: every small batch runs the ONE
-                # compiled max_batch-row program (padding rows sliced
-                # off below; zero rows are finite through every model).
-                # Larger totals (a multi-row request joined) ride the
-                # quarter-octave padded-shape grid shard_rows applies,
-                # which bounds distinct compiled shapes logarithmically.
-                pad = np.zeros(
-                    (self.max_batch - total, rows.shape[1]), rows.dtype
+            with context:
+                self._forward_traced(group, span)
+        finally:
+            if trace is not None:
+                _tracing.remember_trace(trace)
+
+    def _forward_traced(self, group: list, span) -> None:
+        try:
+            # the span covers the registry lookup too, so its
+            # hit/miss verdict (registry.get annotates the ambient
+            # span) and a miss's serve:load_model child both land here
+            with span("serve:forward", requests=len(group)):
+                model = self.registry.get(group[0].path)
+                rows = np.concatenate([request.rows for request in group])
+                total = len(rows)
+                if total < self.max_batch:
+                    # fixed dispatch shape: every small batch runs the
+                    # ONE compiled max_batch-row program (padding rows
+                    # sliced off below; zero rows are finite through
+                    # every model). Larger totals (a multi-row request
+                    # joined) ride the quarter-octave padded-shape grid
+                    # shard_rows applies, which bounds distinct
+                    # compiled shapes logarithmically.
+                    pad = np.zeros(
+                        (self.max_batch - total, rows.shape[1]), rows.dtype
+                    )
+                    rows = np.concatenate([rows, pad])
+                _tracing.annotate(
+                    rows=total,
+                    bytes=int(rows.nbytes),
+                    dtype=str(rows.dtype),
                 )
-                rows = np.concatenate([rows, pad])
-            with span(
-                "serve:forward", requests=len(group), rows=total
-            ):
                 labels, probs = model.predict_both(rows)
         except BaseException as error:  # noqa: BLE001 — delivered to the
             # waiting request threads; the route maps it to an HTTP error

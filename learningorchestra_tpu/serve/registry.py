@@ -130,18 +130,22 @@ class ModelRegistry:
             with self._lock:
                 self._drop_locked(path, invalidation=True)
             raise ModelNotFoundError(path)
+        from learningorchestra_tpu.telemetry import tracing
+
         with self._lock:
             entry = self._entries.get(path)
             if entry is not None and entry.rev == rev:
                 self._entries.move_to_end(path)
                 self.hits += 1
                 self._metrics["hits"].inc()
+                tracing.annotate(registry="hit")
                 return entry.model
             if entry is not None:
                 # a rebuild moved the artifact: never serve stale HBM
                 self._drop_locked(path, invalidation=True)
             self.misses += 1
             self._metrics["misses"].inc()
+            tracing.annotate(registry="miss")
         try:
             model = self._load(path)  # unlocked: probes stay O(us)
         except FileNotFoundError:

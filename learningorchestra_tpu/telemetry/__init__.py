@@ -20,11 +20,14 @@ from learningorchestra_tpu.telemetry.tracing import (
     Span,
     Trace,
     activate,
+    add_attr,
+    annotate,
     attach,
     capture,
     current_correlation_id,
     current_trace,
     mint_correlation_id,
+    record_span,
     span,
 )
 
@@ -33,12 +36,15 @@ __all__ = [
     "Span",
     "Trace",
     "activate",
+    "add_attr",
+    "annotate",
     "attach",
     "capture",
     "current_correlation_id",
     "current_trace",
     "global_registry",
     "mint_correlation_id",
+    "record_span",
     "register_store",
     "span",
 ]

@@ -39,10 +39,19 @@ def mint_correlation_id() -> str:
 
 
 class Span:
-    """One timed operation; children nest within the parent's window."""
+    """One timed operation; children nest within the parent's window.
+
+    Flight-recorder fields (telemetry/profile.py): ``start_ts`` is the
+    epoch anchor, ``duration_s`` comes from the monotonic clock (so two
+    spans on different threads order correctly within a process), and
+    ``tid`` is the OS thread id — the Chrome trace-event exporter lays
+    spans out one row per thread from exactly these three fields. Typed
+    attributes (bytes moved, rows, dtype, compile hit/miss) ride
+    ``meta``."""
 
     __slots__ = (
-        "name", "start_ts", "duration_s", "meta", "children", "_t0", "_trace"
+        "name", "start_ts", "duration_s", "meta", "children", "tid",
+        "_t0", "_trace",
     )
 
     def __init__(self, name: str, trace: "Trace", meta: Optional[dict] = None):
@@ -51,11 +60,19 @@ class Span:
         self.duration_s: Optional[float] = None
         self.meta = meta or {}
         self.children: list[Span] = []
+        self.tid = threading.get_native_id()
         self._t0 = time.perf_counter()
         self._trace = trace
 
     def finish(self) -> None:
         self.duration_s = time.perf_counter() - self._t0
+
+    @property
+    def end_ts(self) -> Optional[float]:
+        """Epoch end: the start anchor plus the monotonic duration."""
+        if self.duration_s is None:
+            return None
+        return self.start_ts + self.duration_s
 
     def as_dict(self) -> dict:
         out = {
@@ -64,6 +81,7 @@ class Span:
             "duration_s": (
                 None if self.duration_s is None else round(self.duration_s, 6)
             ),
+            "tid": self.tid,
             "children": [child.as_dict() for child in self.children],
         }
         if self.meta:
@@ -155,6 +173,41 @@ def span(name: str, **meta) -> Iterator[Optional[Span]]:
     finally:
         span_obj.finish()
         _SPAN.reset(token)
+
+
+def annotate(**attrs) -> None:
+    """Set typed attributes on the CURRENT span (no-op without one) —
+    for instrumentation sites that learn a fact (registry hit/miss,
+    decoded byte count) inside a span someone else opened."""
+    span_obj = _SPAN.get()
+    if span_obj is not None:
+        span_obj.meta.update(attrs)
+
+
+def add_attr(name: str, amount: float) -> None:
+    """Accumulate a numeric attribute on the current span (no-op
+    without one): ``bytes``-style totals built up across a chunk loop
+    land on the one surrounding span instead of needing a span per
+    chunk."""
+    span_obj = _SPAN.get()
+    if span_obj is not None:
+        span_obj.meta[name] = span_obj.meta.get(name, 0) + amount
+
+
+def record_span(name: str, duration_s: float, **meta) -> Optional[Span]:
+    """Append an already-finished span ending NOW to the active trace
+    (no-op without one). For events whose timing arrives as a duration
+    after the fact — jax.monitoring hands compile times to
+    utils/jitcache.py this way — so the timeline still shows WHEN the
+    compiler ran and for how long."""
+    trace = _TRACE.get()
+    if trace is None:
+        return None
+    span_obj = Span(name, trace, meta=meta or None)
+    span_obj.start_ts = time.time() - duration_s
+    span_obj.duration_s = duration_s
+    trace._add(span_obj, _SPAN.get())
+    return span_obj
 
 
 # --- worker-side trace retention -------------------------------------------

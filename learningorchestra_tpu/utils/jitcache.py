@@ -36,15 +36,38 @@ def _on_event(name: str, **_kw) -> None:
     # record_event, not a duration)
     if name == "/jax/compilation_cache/cache_misses":
         _STATS["persistent_cache_misses"] += 1
+        _account_compile(result="miss")
     elif name == "/jax/compilation_cache/cache_hits":
         _STATS["persistent_cache_hits"] += 1
+        _account_compile(result="hit")
 
 
 def _on_duration(name: str, duration_secs: float, **_kw) -> None:
     if name == "/jax/core/compile/backend_compile_duration":
         _STATS["backend_compile_s"] += duration_secs
+        _account_compile(seconds=duration_secs, span_name="compile:backend")
     elif name == "/jax/core/compile/jaxpr_trace_duration":
         _STATS["trace_s"] += duration_secs
+
+
+def _account_compile(result=None, seconds=None, span_name=None) -> None:
+    """Feed the flight recorder (telemetry/profile.py): compile events
+    become ``lo_compile_*`` counters and — when a trace is active on
+    the compiling thread, which it is for every scheduled job — an
+    already-finished span on the job timeline, so a compile-bound
+    build shows WHERE the compiler ate its wall-clock. Listener
+    context: must never raise into jax.monitoring."""
+    try:
+        from learningorchestra_tpu.telemetry import profile, tracing
+
+        profile.account_compile(result=result, seconds=seconds)
+        if span_name is not None and seconds is not None:
+            tracing.record_span(span_name, seconds, compile=True)
+        elif result is not None:
+            # typed hit/miss counts on the enclosing span (fit, build…)
+            tracing.add_attr(f"compile_{result}", 1)
+    except Exception:  # noqa: BLE001 — observability never breaks compiles
+        pass
 
 
 def _register_listeners() -> None:

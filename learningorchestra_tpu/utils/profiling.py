@@ -20,23 +20,31 @@ from learningorchestra_tpu.telemetry import tracing as _tracing
 class PhaseTimer:
     """Accumulates ``{phase: seconds}``; reentrant per phase.
 
-    Each phase also lands as a span in the active trace context (a
-    no-op outside one), so the same ``fit``/``write`` numbers that go to
-    stored metadata appear in the request's correlated span tree
-    (``GET /jobs/<name>/trace``) without double instrumentation."""
+    Each phase ENTRY lands as its own timestamped span in the active
+    trace (a no-op outside one) and as its own row in ``occurrences``:
+    a phase entered twice is two events with distinct start/end
+    boundaries on the timeline — summing them into one bucket would
+    smear ``GET /jobs/<name>/profile``'s Chrome trace. The summed
+    ``as_metadata()`` contract is unchanged: stored job metadata keeps
+    one total per phase name. ``**attrs`` become typed span attributes
+    (rows, bytes, dtype) on that occurrence's span."""
 
     def __init__(self):
         self.timings: dict[str, float] = {}
+        # one row per phase ENTRY: (name, epoch start, seconds)
+        self.occurrences: list[tuple[str, float, float]] = []
 
     @contextlib.contextmanager
-    def phase(self, name: str) -> Iterator[None]:
+    def phase(self, name: str, **attrs) -> Iterator[None]:
         start = time.perf_counter()
+        started_at = time.time()
         try:
-            with _tracing.span(f"phase:{name}"):
+            with _tracing.span(f"phase:{name}", **attrs):
                 yield
         finally:
             elapsed = time.perf_counter() - start
             self.timings[name] = self.timings.get(name, 0.0) + elapsed
+            self.occurrences.append((name, started_at, elapsed))
 
     def as_metadata(self) -> dict[str, float]:
         """Rounded copy for inclusion in stored job metadata."""

@@ -89,12 +89,63 @@ class WebApp:
             "Requests currently being handled",
             labels=("service",),
         )
+        # Flight-recorder byte-flow families declare eagerly so every
+        # service's /metrics shows them from boot (dashboards see the
+        # family before its first byte moves) — profile.py declares
+        # lazily on its own to stay import-light for library embedders.
+        from learningorchestra_tpu.telemetry import profile as _profile
+
+        _profile._flow_metrics()
 
         @self.route("/metrics")
         def serve_metrics(request):
             return Response(
                 self.registry.render(),
                 content_type=_metrics.CONTENT_TYPE,
+                status=200,
+            )
+
+        @self.route("/debug/profile")
+        def debug_profile(request):
+            """Sampling profiler (telemetry/profile.py): sample every
+            thread's stack for ``?seconds=N`` (default 5, clamped to
+            ``LO_PROF_WINDOW_S``) and answer folded flamegraph stacks —
+            a live stall is diagnosable without a restart. Plain text
+            by default (pipe to flamegraph.pl / speedscope);
+            ``?format=json`` wraps the stacks with sample metadata.
+            403 when disabled (``LO_PROF_HZ=0``)."""
+            from learningorchestra_tpu.telemetry import profile as _profile
+
+            try:
+                seconds = float(request.args.get("seconds", "5"))
+            except ValueError:
+                return {"result": "bad_seconds"}, 400
+            if not seconds > 0 or seconds != seconds:  # NaN included
+                return {"result": "bad_seconds"}, 400
+            try:
+                stacks, samples = _profile.sample_stacks(seconds)
+            except RuntimeError:
+                return {"result": "profiler_disabled"}, 403
+            except ValueError as error:
+                # malformed LO_PROF_* in a process that skipped the
+                # run.sh preflight (library embedder, hand-launched
+                # service): clean JSON, never a traceback — this is the
+                # endpoint for diagnosing an already-sick process
+                return {
+                    "result": "invalid_prof_config",
+                    "error": str(error),
+                }, 500
+            if request.args.get("format") == "json":
+                return {
+                    "result": {
+                        "stacks": stacks,
+                        "samples": samples,
+                        "hz": _profile.prof_hz(),
+                    }
+                }, 200
+            return Response(
+                _profile.folded_text(stacks),
+                mimetype="text/plain",
                 status=200,
             )
 
@@ -109,6 +160,26 @@ class WebApp:
             if record is None:
                 return {"result": "not_found"}, 404
             return {"result": record.trace_dict()}, 200
+
+        @self.route("/jobs/<job_name>/profile")
+        def read_job_profile(request, job_name):
+            """The job's merged timeline as Chrome trace-event JSON
+            (load in Perfetto: one row per thread, byte counter
+            tracks); ``?format=summary`` returns the per-phase
+            seconds/bytes/rows-per-s rollup instead — the shape
+            ``bench.py --compare`` diffs (docs/profiling.md)."""
+            from learningorchestra_tpu.telemetry import profile as _profile
+
+            record = jobs.get(job_name)
+            if record is None:
+                return {"result": "not_found"}, 404
+            if record.trace is None:
+                return {"result": "no_trace"}, 404
+            if request.args.get("format") == "summary":
+                summary = _profile.trace_summary(record.trace)
+                summary["job"] = record.as_dict()
+                return {"result": summary}, 200
+            return _profile.chrome_trace(record.trace), 200
 
     def register_job_routes(self, jobs) -> None:
         """The full job surface for a service holding a JobManager:

@@ -556,6 +556,44 @@ class TestServingManifest:
             load({"timeout_s": 0})
 
 
+class TestProfilingManifest:
+    def test_profiling_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["profiling"] = {"prof_hz": 19, "prof_window_s": 30}
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # a stall diagnosis must work on ANY member
+            env = plan["env"]
+            assert env["LO_PROF_HZ"] == "19"
+            assert env["LO_PROF_WINDOW_S"] == "30"
+
+    def test_profiling_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(profiling):
+            manifest = _manifest()
+            manifest["profiling"] = profiling
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # hz 0 = endpoint disabled: valid; fractional window: valid
+        loaded = load({"prof_hz": 0, "prof_window_s": 0.5})
+        assert loaded["profiling"]["prof_hz"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"prof_hz": -1})
+        with pytest.raises(SystemExit):
+            load({"prof_hz": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"prof_hz": 9.5})  # rates are integers
+        with pytest.raises(SystemExit):
+            load({"prof_window_s": 0})
+
+
 class TestMetricsScrape:
     def test_parse_prometheus_sums_families(self):
         cluster = _load_cluster_module()
