@@ -122,11 +122,18 @@ if armed:
     print(f"run.sh: FAULT INJECTION ARMED: {armed} (chaos drill?)")
 EOF
 
-# SPMD-safety preflight (docs/analysis.md): refuse to serve a build
-# that violates the cross-host invariants — a divergence bug found here
-# costs seconds; found in production it costs a poisoned runtime and a
-# supervisor restart. LO_ANALYSIS_WARN=1 downgrades to log-and-warn for
-# emergency hotfixes.
-python -m learningorchestra_tpu.analysis learningorchestra_tpu
+# SPMD-safety + concurrency preflight (docs/analysis.md): refuse to
+# serve a build that violates the cross-host invariants (LO1xx) or the
+# lock-discipline invariants of the threaded serving stack (LO2xx) — a
+# bug found here costs seconds; found in production it costs a poisoned
+# runtime or a deadlocked lock and a supervisor restart.
+# LO_ANALYSIS_WARN=1 downgrades to log-and-warn for emergency hotfixes;
+# LO_ANALYSIS_CHANGED=1 blocks only on findings NEW since the git
+# merge-base (forks and feature branches carrying an upstream backlog).
+if [ "${LO_ANALYSIS_CHANGED:-0}" = "1" ]; then
+    python -m learningorchestra_tpu.analysis --changed learningorchestra_tpu
+else
+    python -m learningorchestra_tpu.analysis learningorchestra_tpu
+fi
 
 exec python -m learningorchestra_tpu.services.runner

@@ -11,6 +11,17 @@ package machine-checks them at the AST level, before anything runs:
 - ``LO103`` host sync hidden inside jit-compiled code
 - ``LO104`` float64 dtype in device code
 
+plus the concurrency-hazard family over the threaded serving stack
+(``analysis/concurrency.py``; RacerD-style lockset reasoning, one
+module at a time):
+
+- ``LO201`` inconsistent / registry-violating lock acquisition order
+- ``LO202`` blocking call (network, sleep, join, device sync, store
+  wire) inside a held-lock scope
+- ``LO203`` attribute accessed both with and without its lock
+- ``LO204`` Condition.wait/notify outside the predicate-loop discipline
+- ``LO205`` guarded mutation torn across separate lock scopes
+
 CLI: ``python -m learningorchestra_tpu.analysis [paths...]`` (see
 ``--help``; docs/analysis.md walks through each rule and the baseline
 workflow). Library: :func:`analyze_source` / :func:`analyze_paths`.

@@ -31,7 +31,11 @@ def _build_parser() -> argparse.ArgumentParser:
         description=(
             "SPMD-safety analyzer: collective deadlocks (LO101), "
             "broadcast nondeterminism (LO102), trace-unsafe host syncs "
-            "(LO103), float64 in device code (LO104)."
+            "(LO103), float64 in device code (LO104) — plus the "
+            "concurrency-hazard family: lock order (LO201), blocking "
+            "calls under locks (LO202), unguarded shared state "
+            "(LO203), condvar discipline (LO204), torn publishes "
+            "(LO205)."
         ),
     )
     parser.add_argument(
@@ -59,6 +63,23 @@ def _build_parser() -> argparse.ArgumentParser:
         default=None,
         metavar="RULES",
         help="comma-separated rule ids to run (e.g. LO101,LO103)",
+    )
+    parser.add_argument(
+        "--changed",
+        action="store_true",
+        help=(
+            "only fail on findings NEW since the git merge-base with "
+            "--base (the merge-base's findings print as (baselined))"
+        ),
+    )
+    parser.add_argument(
+        "--base",
+        default="",
+        metavar="REF",
+        help=(
+            "ref --changed diffs against via `git merge-base HEAD REF` "
+            "(default: origin/main, then main)"
+        ),
     )
     parser.add_argument(
         "--warn-only",
@@ -130,8 +151,43 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.changed and (args.write_baseline or args.baseline):
+        # two competing definitions of "old" (a checked-in file vs the
+        # merge-base) would silently double-grandfather; pick one
+        print(
+            "--changed is mutually exclusive with --baseline/"
+            "--write-baseline",
+            file=sys.stderr,
+        )
+        return 2
+    if args.base and not args.changed:
+        print("--base only makes sense with --changed", file=sys.stderr)
+        return 2
+    changed_root = None
+    changed_base = None
+    if args.changed:
+        baseline_path = None  # merge-base supersedes the auto-default
+        from learningorchestra_tpu.analysis.changed import (
+            ChangedModeError,
+            resolve_merge_base,
+        )
+
+        try:
+            changed_root, changed_base = resolve_merge_base(args.base)
+        except ChangedModeError as error:
+            print(f"--changed: {error}", file=sys.stderr)
+            return 2
 
     findings = analyze_paths(args.paths, select)
+
+    if changed_root is not None:
+        from learningorchestra_tpu.analysis.changed import base_findings
+
+        findings = apply_baseline(
+            findings,
+            base_findings(args.paths, select, changed_root, changed_base),
+            changed_root,
+        )
 
     if args.write_baseline:
         write_baseline(baseline_path or DEFAULT_BASELINE, findings)

@@ -1,0 +1,770 @@
+"""The concurrency-hazard rule family (LO201–LO205).
+
+Seventeen modules in this codebase hold ``threading.Lock`` / ``RLock`` /
+``Condition`` state — scheduler queues, the device cache, the serving
+registry and micro-batcher, replication/arbiter role state, telemetry
+rings — and the review-hardening log of PRs 3–8 is a catalog of one bug
+class found by eyeball: a checkpoint load blocking the registry lock, a
+record/event/task publish torn across lock releases, a candidate's term
+and self-vote computed under two lock acquisitions, a ``wait()``
+snapshot read racing registration. These rules machine-check the same
+invariants, RacerD-style (lockset reasoning, one module at a time):
+
+- **LO201 lock-order** — a nested ``with`` acquisition graph per
+  module: A-then-B somewhere and B-then-A elsewhere is a deadlock the
+  moment both paths run concurrently; acquisitions of locks named in
+  the declared :data:`LOCK_REGISTRY` must also respect its global
+  ranks (the cross-module ordering a per-module analysis cannot see).
+- **LO202 blocking-call-under-lock** — network I/O, ``time.sleep``,
+  subprocess spawns, thread joins / executor shutdowns, unbounded
+  waits, device syncs (``block_until_ready``), checkpoint loads, and
+  store wire calls inside a held-lock scope stall every other thread
+  parked on that lock (the "GET /models hangs behind a checkpoint
+  load" shape fixed by hand in PR 7).
+- **LO203 unguarded shared state** — lockset-lite inference: an
+  attribute accessed under a class's lock somewhere but read/written
+  bare elsewhere, with at least one write in the mix. The golden
+  cases are the ``JobManager.wait()`` snapshot race and the
+  ``store_token`` minting race, both found by hand in PRs 3–4.
+  Methods named ``*_locked`` are treated as lock-held by convention
+  (the codebase's existing ``_drop_locked`` / ``_evict_locked``
+  idiom); ``__init__`` is exempt (construction precedes sharing).
+- **LO204 condvar discipline** — ``Condition.wait`` must sit inside a
+  predicate loop (a bare wait misses a notify that fired early and a
+  spurious wakeup breaks it) and carry a timeout (a lost notify must
+  degrade to a re-check, not a hang); ``notify``/``notify_all`` must
+  run under the same lock's ``with``.
+- **LO205 torn-publish** — the same guarded attribute mutated in two
+  separate ``with``-blocks of one method: an observer acquiring the
+  lock between them sees the half-published state (the
+  ``_finalize``/DELETE race shape from PR 3).
+
+Like the LO1xx family the detectors are syntactic — one module at a
+time, no cross-function dataflow — so every finding is explainable by
+pointing at the flagged line. ``# lo: allow[LO2xx]`` suppresses a
+deliberate occurrence in place (with a justifying comment); the
+baseline workflow grandfathers the rest. docs/analysis.md has the
+per-rule tables and the lock-registry contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional
+
+from learningorchestra_tpu.analysis.core import Finding
+
+# --------------------------------------------------------------------
+# lock recognition
+# --------------------------------------------------------------------
+
+# A with-context expression is a lock scope when its final name part is
+# lock-like: `self._lock`, `cls.cond`, `_GLOBAL_LOCK`, `role["lock"]`,
+# `repl_cv`. Matching the TAIL only keeps `unlock()`/`blocked` out.
+_LOCKISH_TAIL = re.compile(
+    r"(?i)(?:^|_)(?:lock|mutex|cond|cv|condition)$"
+)
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _last_part(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def lock_name(node: ast.AST) -> Optional[str]:
+    """The normalized identity of a lock-like expression, or None.
+
+    ``self._lock`` → ``"self._lock"``; ``role["lock"]`` →
+    ``"role['lock']"``. Identity is textual: two methods writing
+    ``with self._lock:`` mean the same lock within one class, which is
+    exactly the per-module granularity these rules work at.
+    """
+    name = _dotted(node)
+    if name is not None:
+        return name if _LOCKISH_TAIL.search(_last_part(name)) else None
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.slice, ast.Constant)
+        and isinstance(node.slice.value, str)
+    ):
+        key = node.slice.value
+        base = _dotted(node.value)
+        if base is not None and _LOCKISH_TAIL.search(key):
+            return f"{base}[{key!r}]"
+    return None
+
+
+def _with_locks(stmt: ast.AST) -> list[str]:
+    """Lock names acquired by a With statement (empty for non-With)."""
+    if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return []
+    names = []
+    for item in stmt.items:
+        name = lock_name(item.context_expr)
+        if name is not None:
+            names.append(name)
+    return names
+
+
+def _function_defs(tree: ast.Module) -> Iterator[ast.AST]:
+    """Every def (and the module top level) as an independent walk
+    root. Nested defs are visited as their own roots with an EMPTY
+    lock context: a closure defined under a lock runs on its own
+    schedule, not with the lock held."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _iter_scoped(
+    body: list[ast.stmt], held: tuple[str, ...]
+) -> Iterator[tuple[ast.stmt, tuple[str, ...]]]:
+    """Yield ``(statement, locks_held)`` for every statement lexically
+    inside ``body``, tracking ``with <lock>:`` scopes and pruning
+    nested function/lambda bodies (deferred code)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield stmt, held
+        inner = held
+        acquired = _with_locks(stmt)
+        if acquired:
+            inner = held + tuple(acquired)
+        for block in (
+            getattr(stmt, "body", None),
+            getattr(stmt, "orelse", None),
+            getattr(stmt, "finalbody", None),
+        ):
+            if isinstance(block, list) and block and isinstance(
+                block[0], ast.stmt
+            ):
+                yield from _iter_scoped(block, inner)
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from _iter_scoped(handler.body, inner)
+        for case in getattr(stmt, "cases", []) or []:
+            yield from _iter_scoped(case.body, inner)
+
+
+def _own_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """The statement's own expressions, without nested statements or
+    def/lambda bodies. Statement nodes are pruned at EVERY level, not
+    just the first: an ``except`` handler is not itself a statement,
+    and descending through it would re-visit its body's statements
+    with the wrong lock context."""
+    stack = [
+        child
+        for child in ast.iter_child_nodes(stmt)
+        if not isinstance(child, ast.stmt)
+    ]
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node,
+            (
+                ast.stmt,
+                ast.FunctionDef,
+                ast.AsyncFunctionDef,
+                ast.Lambda,
+            ),
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------
+# the declared cross-module lock registry (LO201)
+# --------------------------------------------------------------------
+
+# Global ranks for the process-wide module-level locks: LOWER rank
+# locks are acquired FIRST (outermost). A module nesting two ranked
+# locks against their ranks is flagged even when the module's own
+# acquisition graph is (locally) acyclic — this is the only ordering
+# evidence a per-module analysis can carry across module boundaries,
+# so every call chain below is ordered outer→inner by construction:
+#
+#   builder trace capture (10) → chaos fault check (20) →
+#   singleton construction (30–50) → telemetry rings/decls (60–70) →
+#   metrics registry declaration (80, innermost: every subsystem's
+#   get-or-create metric declaration lands here).
+#
+# Keys are (module path suffix, lock name as written); the suffix is
+# matched against the analyzed file's posix path. Adding a module-level
+# lock? Register it at the rank matching what it may call into —
+# docs/analysis.md ("The lock registry") walks the tiers.
+LOCK_REGISTRY: dict[tuple[str, str], int] = {
+    ("ml/builder.py", "_TRACE_LOCK"): 10,
+    ("testing/faults.py", "_LOCK"): 20,
+    ("core/jobs.py", "_MANAGER_LOCK"): 30,
+    ("core/store.py", "_GLOBAL_LOCK"): 30,
+    ("serve/__init__.py", "_GLOBAL_LOCK"): 30,
+    ("core/devcache.py", "_GLOBAL_LOCK"): 40,
+    ("core/devcache.py", "_TOKEN_LOCK"): 50,
+    ("native/loader.py", "_lock"): 50,
+    ("telemetry/tracing.py", "_RECENT_LOCK"): 60,
+    ("serve/batcher.py", "_METRICS_LOCK"): 70,
+    ("serve/registry.py", "_METRICS_LOCK"): 70,
+    ("telemetry/profile.py", "_METRICS_LOCK"): 70,
+    ("telemetry/metrics.py", "_GLOBAL_LOCK"): 80,
+}
+
+
+def _registry_rank(path: str, lock: str) -> Optional[int]:
+    normalized = path.replace("\\", "/")
+    for (suffix, name), rank in LOCK_REGISTRY.items():
+        if name == lock and normalized.endswith(suffix):
+            return rank
+    return None
+
+
+def check_lo201(tree: ast.Module, path: str) -> Iterator[Finding]:
+    """Lock-order: nested acquisitions build a per-module graph; a
+    cycle (A→B and B→A) deadlocks the first time both paths run
+    concurrently. Self-nesting of one name is flagged too (fatal
+    unless the lock is an RLock — suppress in place if so), and
+    ranked registry locks must nest outer→inner."""
+    # edge (outer, inner) → first line it was seen at
+    edges: dict[tuple[str, str], int] = {}
+    for func in _function_defs(tree):
+        for stmt, held in _iter_scoped(getattr(func, "body", []), ()):
+            acquired = _with_locks(stmt)
+            if not acquired:
+                continue
+            chain = list(held)
+            for inner in acquired:
+                for outer in chain:
+                    if outer == inner:
+                        yield Finding(
+                            "",
+                            stmt.lineno,
+                            "LO201",
+                            f"`{inner}` is acquired while already "
+                            "held — self-deadlock unless it is an "
+                            "RLock (if so, suppress in place with a "
+                            "comment saying which)",
+                        )
+                        continue
+                    edges.setdefault((outer, inner), stmt.lineno)
+                    outer_rank = _registry_rank(path, outer)
+                    inner_rank = _registry_rank(path, inner)
+                    if (
+                        outer_rank is not None
+                        and inner_rank is not None
+                        and outer_rank > inner_rank
+                    ):
+                        yield Finding(
+                            "",
+                            stmt.lineno,
+                            "LO201",
+                            f"`{inner}` (registry rank {inner_rank}) "
+                            f"acquired under `{outer}` (rank "
+                            f"{outer_rank}) — violates the declared "
+                            "lock-registry order "
+                            "(analysis/concurrency.py LOCK_REGISTRY)",
+                        )
+                chain.append(inner)
+    for (outer, inner), line in sorted(
+        edges.items(), key=lambda item: item[1]
+    ):
+        if (inner, outer) in edges and outer < inner:
+            other = edges[(inner, outer)]
+            yield Finding(
+                "",
+                max(line, other),
+                "LO201",
+                f"inconsistent lock order: `{outer}` → `{inner}` and "
+                f"`{inner}` → `{outer}` both occur in this module — "
+                "two threads taking opposite paths deadlock",
+            )
+
+
+# --------------------------------------------------------------------
+# LO202 — blocking calls under a held lock
+# --------------------------------------------------------------------
+
+# Dotted call names that block the calling thread for unbounded or
+# wall-clock time. Everything parked on the held lock stalls with it.
+BLOCKING_CALLS: dict[str, str] = {
+    "time.sleep": "sleeps on the wall clock",
+    "urllib.request.urlopen": "performs network I/O",
+    "urlopen": "performs network I/O",
+    "requests.get": "performs network I/O",
+    "requests.post": "performs network I/O",
+    "requests.put": "performs network I/O",
+    "requests.delete": "performs network I/O",
+    "requests.head": "performs network I/O",
+    "requests.request": "performs network I/O",
+    "socket.create_connection": "performs network I/O",
+    "subprocess.run": "waits on a subprocess",
+    "subprocess.call": "waits on a subprocess",
+    "subprocess.check_call": "waits on a subprocess",
+    "subprocess.check_output": "waits on a subprocess",
+    "subprocess.Popen": "spawns a subprocess",
+    "os.system": "waits on a subprocess",
+    "os.popen": "waits on a subprocess",
+    "jax.block_until_ready": "synchronizes the device queue",
+    "block_until_ready": "synchronizes the device queue",
+    "jax.device_get": "synchronizes the device queue",
+    "pickle.load": "loads an artifact from disk",
+    "np.load": "loads an artifact from disk",
+    "numpy.load": "loads an artifact from disk",
+    "load_model": "loads a checkpoint (disk + H2D transfer)",
+    "load_checkpoint": "loads a checkpoint (disk + H2D transfer)",
+}
+
+# Method tails that block regardless of receiver: thread/pool joins and
+# future results are waits on OTHER threads' progress — under a lock
+# those threads may need, that is the textbook lock-held deadlock.
+# ``join`` is handled separately (a thread join only when the receiver
+# looks like a thread/pool — ``", ".join`` and ``os.path.join`` are
+# string/path operations).
+BLOCKING_METHOD_TAILS: dict[str, str] = {
+    "shutdown": "waits for an executor's threads",
+    "stop": "stops (typically joins) a worker",
+    "result": "blocks on a future",
+    "block_until_ready": "synchronizes the device queue",
+}
+
+_THREADY_RECEIVER = re.compile(r"(?i)thread|worker|pool|proc")
+
+# Store wire methods: on a RemoteStore each is an HTTP round trip (and
+# mid-failover, a retry loop bounded only by LO_FAILOVER_TIMEOUT_S).
+# Receiver `self`/`cls` is exempt — the in-memory store's internal
+# re-entrant calls under its own RLock are its design.
+STORE_METHOD_TAILS = {
+    "insert_one",
+    "insert_many",
+    "insert_columns",
+    "insert_column_arrays",
+    "update_one",
+    "set_column",
+    "set_field_values",
+    "read_columns",
+    "read_column_arrays",
+    "read_column_arrays_rev",
+    "wal_feed",
+    "resync_apply",
+    "apply_replicated",
+    "create_collection",
+    "aggregate",
+}
+
+
+def _call_blocks(call: ast.Call, held: tuple[str, ...]) -> Optional[str]:
+    name = _dotted(call.func)
+    if name is not None:
+        if name in BLOCKING_CALLS:
+            return f"{name}() {BLOCKING_CALLS[name]}"
+        last = _last_part(name)
+        if last in BLOCKING_CALLS and last == name:
+            return f"{name}() {BLOCKING_CALLS[last]}"
+    if isinstance(call.func, ast.Attribute):
+        tail = call.func.attr
+        receiver = _dotted(call.func.value) or ""
+        receiver_root = receiver.split(".", 1)[0]
+        if tail in BLOCKING_METHOD_TAILS:
+            return f".{tail}() {BLOCKING_METHOD_TAILS[tail]}"
+        if tail == "join" and _THREADY_RECEIVER.search(
+            _last_part(receiver)
+        ):
+            return (
+                f"{receiver}.join() joins a thread (unbounded without "
+                "a timeout argument)"
+            )
+        if tail in STORE_METHOD_TAILS and receiver_root not in (
+            "self",
+            "cls",
+            "",
+        ):
+            return (
+                f"{receiver}.{tail}() is a store call — an HTTP round "
+                "trip on a RemoteStore backend"
+            )
+        if (
+            tail == "get"
+            and "queue" in _last_part(receiver).lower()
+            or tail == "get"
+            and "inbox" in _last_part(receiver).lower()
+        ):
+            if not call.args and not any(
+                kw.arg == "timeout" for kw in call.keywords
+            ):
+                return (
+                    f"{receiver}.get() without a timeout parks this "
+                    "thread until a producer shows up"
+                )
+        if tail == "wait":
+            # waiting on the HELD lock's own condition is the condvar
+            # idiom (wait releases it — LO204's domain); waiting on
+            # anything ELSE while holding a lock is a stall, flagged
+            # only when unbounded (no timeout argument).
+            if receiver not in held and not call.args and not call.keywords:
+                return (
+                    f"{receiver}.wait() with no timeout parks this "
+                    "thread indefinitely"
+                )
+    return None
+
+
+def check_lo202(tree: ast.Module, path: str) -> Iterator[Finding]:
+    del path
+    seen: set[tuple[int, str]] = set()
+    for func in _function_defs(tree):
+        for stmt, held in _iter_scoped(getattr(func, "body", []), ()):
+            locks = held + tuple(_with_locks(stmt))
+            if not locks:
+                continue
+            for node in _own_exprs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _call_blocks(node, locks)
+                if reason is None:
+                    continue
+                key = (node.lineno, reason)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    "",
+                    node.lineno,
+                    "LO202",
+                    f"{reason} while holding `{locks[-1]}` — every "
+                    "thread parked on that lock stalls with it "
+                    "(move the slow work outside the lock scope)",
+                )
+
+
+# --------------------------------------------------------------------
+# LO203 — unguarded shared state (lockset-lite)
+# --------------------------------------------------------------------
+
+# Method-call tails that mutate their receiver in place.
+MUTATING_TAILS = {
+    "pop",
+    "popitem",
+    "popleft",
+    "append",
+    "appendleft",
+    "extend",
+    "insert",
+    "remove",
+    "discard",
+    "add",
+    "clear",
+    "update",
+    "setdefault",
+}
+
+
+class _Access:
+    __slots__ = ("attr", "line", "method", "locked", "write")
+
+    def __init__(self, attr, line, method, locked, write):
+        self.attr = attr
+        self.line = line
+        self.method = method
+        self.locked = locked
+        self.write = write
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``X`` for a direct ``self.X`` attribute node."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_accesses(method: ast.FunctionDef) -> Iterator[_Access]:
+    convention_locked = method.name.endswith("_locked")
+    for stmt, held in _iter_scoped(method.body, ()):
+        locked = convention_locked or bool(held) or bool(_with_locks(stmt))
+        writes: dict[int, str] = {}  # id(attr node) → attr, for targets
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for target in targets:
+            # the written attribute: `self.X = ...`, `self.X += ...`,
+            # `self.X[k] = ...` (container mutation), `del self.X`,
+            # and tuple-unpacked combinations thereof
+            for node in ast.walk(target):
+                attr = _self_attr(node)
+                if attr is not None:
+                    writes[id(node)] = attr
+        for node in _own_exprs(stmt):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute
+            ):
+                if node.func.attr in MUTATING_TAILS:
+                    attr = _self_attr(node.func.value)
+                    if attr is not None:
+                        writes[id(node.func.value)] = attr
+        emitted: set[tuple[str, bool]] = set()
+        for node in _own_exprs(stmt):
+            attr = _self_attr(node)
+            if attr is None:
+                continue
+            write = id(node) in writes
+            key = (attr, write)
+            if key in emitted:
+                continue
+            emitted.add(key)
+            yield _Access(attr, node.lineno, method.name, locked, write)
+
+
+def check_lo203(tree: ast.Module, path: str) -> Iterator[Finding]:
+    del path
+    for klass in ast.walk(tree):
+        if not isinstance(klass, ast.ClassDef):
+            continue
+        accesses: list[_Access] = []
+        for item in klass.body:
+            if not isinstance(
+                item, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if item.name == "__init__":
+                continue  # construction precedes sharing
+            accesses.extend(_collect_accesses(item))
+        by_attr: dict[str, list[_Access]] = {}
+        for access in accesses:
+            # the lock attributes themselves are synchronization, not
+            # shared data; queues/events carry their own locking
+            if _LOCKISH_TAIL.search(access.attr):
+                continue
+            by_attr.setdefault(access.attr, []).append(access)
+        for attr, attr_accesses in sorted(by_attr.items()):
+            locked = [a for a in attr_accesses if a.locked]
+            bare = [a for a in attr_accesses if not a.locked]
+            if not locked or not bare:
+                continue
+            if not any(a.write for a in attr_accesses):
+                continue  # read-only everywhere: immutable config
+            reported: set[str] = set()
+            for access in sorted(bare, key=lambda a: a.line):
+                if access.method in reported:
+                    continue
+                reported.add(access.method)
+                guarded_in = sorted(
+                    {a.method for a in locked if a.write}
+                ) or sorted({a.method for a in locked})
+                kind = "written" if access.write else "read"
+                yield Finding(
+                    "",
+                    access.line,
+                    "LO203",
+                    f"`self.{attr}` is {kind} without the lock that "
+                    f"guards it in {', '.join(guarded_in)}() — a "
+                    "concurrent holder sees (or produces) a torn "
+                    "value; snapshot/mutate it under the lock",
+                )
+
+
+# --------------------------------------------------------------------
+# LO204 — condition-variable discipline
+# --------------------------------------------------------------------
+
+
+def check_lo204(tree: ast.Module, path: str) -> Iterator[Finding]:
+    del path
+    for func in _function_defs(tree):
+        body = getattr(func, "body", [])
+        yield from _lo204_walk(body, held=(), loops=0)
+
+
+def _lo204_walk(
+    body: list[ast.stmt], held: tuple[str, ...], loops: int
+) -> Iterator[Finding]:
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        inner_held = held + tuple(_with_locks(stmt))
+        inner_loops = loops + (1 if isinstance(stmt, (ast.While, ast.For)) else 0)
+        for node in _own_exprs(stmt):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            receiver = _dotted(node.func.value)
+            if receiver is None:
+                continue
+            tail = node.func.attr
+            if tail == "wait" and receiver in held:
+                # a wait on the condition whose `with` we are inside
+                if loops == 0:
+                    yield Finding(
+                        "",
+                        node.lineno,
+                        "LO204",
+                        f"`{receiver}.wait()` outside a predicate "
+                        "loop — a notify that fired early is missed "
+                        "forever and a spurious wakeup proceeds on a "
+                        "false predicate; use `while not <pred>: "
+                        f"{receiver}.wait(timeout)`",
+                    )
+                elif not node.args and not node.keywords:
+                    yield Finding(
+                        "",
+                        node.lineno,
+                        "LO204",
+                        f"`{receiver}.wait()` without a timeout — a "
+                        "lost notify (worker died mid-critical-"
+                        "section, shutdown raced the wait) parks "
+                        "this thread forever; pass a timeout and let "
+                        "the predicate loop re-check",
+                    )
+            elif tail in ("notify", "notify_all"):
+                if (
+                    lock_name(node.func.value) is not None
+                    and receiver not in inner_held
+                ):
+                    yield Finding(
+                        "",
+                        node.lineno,
+                        "LO204",
+                        f"`{receiver}.{tail}()` outside `with "
+                        f"{receiver}:` — notify without the lock "
+                        "races the waiter's predicate check "
+                        "(RuntimeError at best, a lost wakeup at "
+                        "worst)",
+                    )
+        for block in (
+            getattr(stmt, "body", None),
+            getattr(stmt, "orelse", None),
+            getattr(stmt, "finalbody", None),
+        ):
+            if isinstance(block, list) and block and isinstance(
+                block[0], ast.stmt
+            ):
+                yield from _lo204_walk(block, inner_held, inner_loops)
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from _lo204_walk(handler.body, inner_held, inner_loops)
+        for case in getattr(stmt, "cases", []) or []:
+            yield from _lo204_walk(case.body, inner_held, inner_loops)
+
+
+# --------------------------------------------------------------------
+# LO205 — torn publish across separate lock scopes
+# --------------------------------------------------------------------
+
+
+def _mutated_attrs_under(
+    with_stmt: ast.With, lock: str
+) -> set[str]:
+    """Self-attributes mutated lexically inside ``with_stmt``'s body
+    (not inside nested withs of OTHER locks — those publish under a
+    different guard — and not inside nested defs)."""
+    mutated: set[str] = set()
+    for stmt, held in _iter_scoped(with_stmt.body, (lock,)):
+        if held != (lock,):
+            continue
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+        for target in targets:
+            for node in ast.walk(target):
+                attr = _self_attr(node)
+                if attr is not None:
+                    mutated.add(attr)
+        for node in _own_exprs(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATING_TAILS
+            ):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    mutated.add(attr)
+    return mutated
+
+
+def check_lo205(tree: ast.Module, path: str) -> Iterator[Finding]:
+    del path
+    for func in _function_defs(tree):
+        if isinstance(func, ast.Module):
+            continue
+        # every with-block of each lock, in source order
+        blocks: dict[str, list[tuple[ast.With, set[str]]]] = {}
+        for stmt, _held in _iter_scoped(getattr(func, "body", []), ()):
+            if not isinstance(stmt, ast.With):
+                continue
+            for lock in _with_locks(stmt):
+                blocks.setdefault(lock, []).append(
+                    (stmt, _mutated_attrs_under(stmt, lock))
+                )
+        for lock, lock_blocks in blocks.items():
+            if len(lock_blocks) < 2:
+                continue
+            published: set[str] = set()
+            reported: set[str] = set()
+            for stmt, mutated in lock_blocks:
+                torn = sorted(
+                    attr
+                    for attr in mutated
+                    if attr in published and attr not in reported
+                )
+                reported.update(torn)
+                if torn:
+                    names = ", ".join(f"self.{attr}" for attr in torn)
+                    # no line numbers in the message: baseline and
+                    # --changed keys are line-number-free by contract,
+                    # and an embedded lineno would resurrect
+                    # grandfathered findings on unrelated line shifts
+                    yield Finding(
+                        "",
+                        stmt.lineno,
+                        "LO205",
+                        f"{names} mutated under `{lock}` here AND in "
+                        "an earlier lock scope of the same method — a "
+                        "thread acquiring the lock between the two "
+                        "blocks observes the half-published state; "
+                        "publish related mutations in ONE scope",
+                    )
+                published.update(mutated)
+
+
+# --------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------
+
+CONCURRENCY_RULES = {
+    "LO201": (
+        check_lo201,
+        "inconsistent or registry-violating lock acquisition order",
+    ),
+    "LO202": (check_lo202, "blocking call inside a held-lock scope"),
+    "LO203": (
+        check_lo203,
+        "shared attribute accessed both with and without its lock",
+    ),
+    "LO204": (
+        check_lo204,
+        "Condition.wait/notify outside the predicate-loop discipline",
+    ),
+    "LO205": (
+        check_lo205,
+        "guarded attribute mutation torn across separate lock scopes",
+    ),
+}

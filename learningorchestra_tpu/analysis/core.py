@@ -89,7 +89,7 @@ def analyze_source(
     source_lines = source.splitlines()
     findings = [
         replace(finding, path=path)
-        for finding in rules.run_rules(tree)
+        for finding in rules.run_rules(tree, path)
     ]
     if select is not None:
         findings = [

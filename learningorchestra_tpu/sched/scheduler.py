@@ -238,7 +238,11 @@ class Scheduler:
             with cls.cond:
                 while not cls.heap and not self._closed:
                     cls.idle += 1
-                    cls.cond.wait()
+                    # timed wait (LO204): a lost notify — close() racing
+                    # the wait, a worker dying mid-critical-section —
+                    # degrades to a 1 s predicate re-check, not a
+                    # parked-forever worker
+                    cls.cond.wait(1.0)
                     cls.idle -= 1
                 if self._closed:
                     cls.workers -= 1
@@ -294,10 +298,14 @@ class Scheduler:
         once — the cancelled token short-circuits execution into the
         job's terminal bookkeeping, so run_sync/wait callers wake with
         a CANCELLED record instead of blocking forever."""
-        self._closed = True
         stranded: list[Task] = []
         for cls in self._classes.values():
             with cls.cond:
+                # set under EACH class's lock (LO203): enqueue and the
+                # workers read _closed under their class lock, so the
+                # flag must be published under the same locks — after
+                # this loop every class has observed it
+                self._closed = True
                 while cls.heap:
                     _, _, task = heapq.heappop(cls.heap)
                     stranded.append(task)

@@ -126,6 +126,11 @@ class MicroBatcher:
         self.batches = 0
         self.batched_requests = 0
         self.rejected = 0
+        # worker-thread-private dispatch counter driving the 1-in-N
+        # trace sampling: only the worker loop touches it, so it needs
+        # no lock — unlike self.batches, which stats() reads under the
+        # lock and must therefore also be WRITTEN under it (LO203)
+        self._dispatches = 0
         self._metrics = _serve_batch_metrics()
 
     # --- submission (request threads) ----------------------------------------
@@ -258,8 +263,9 @@ class MicroBatcher:
         # flight-recorder evidence: batch rows/bytes and the registry
         # hit/miss verdict ride the serve:forward span.
         trace = None
-        if self.trace_every and self.batches % self.trace_every == 0:
+        if self.trace_every and self._dispatches % self.trace_every == 0:
             trace = _tracing.Trace(name=f"serve:{group[0].path}")
+        self._dispatches += 1
         context = (
             _tracing.activate(trace)
             if trace is not None
@@ -305,8 +311,12 @@ class MicroBatcher:
                 request.error = error
                 request.finish()
             return
-        self.batches += 1
-        self.batched_requests += len(group)
+        # published under the lock: stats() reads these two together
+        # under self._lock, and a bare increment here could hand it a
+        # mean_batch_size computed from a torn pair (LO203)
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += len(group)
         self._metrics["batch_size"].observe(len(group))
         self._metrics["batches"].inc()
         self._metrics["predictions"].inc(total)

@@ -29,12 +29,16 @@ class ValidationError(Exception):
     """Carries the reference's error string as ``args[0]``."""
 
 
-def filename_exists(store: DocumentStore, filename: str, message: str = MESSAGE_INVALID_FILENAME) -> None:
+def filename_exists(
+    store: DocumentStore, filename: str, message: str = MESSAGE_INVALID_FILENAME
+) -> None:
     if filename not in store.list_collections():
         raise ValidationError(message)
 
 
-def filename_free(store: DocumentStore, filename: str, message: str = MESSAGE_DUPLICATE_FILE) -> None:
+def filename_free(
+    store: DocumentStore, filename: str, message: str = MESSAGE_DUPLICATE_FILE
+) -> None:
     if filename in store.list_collections():
         raise ValidationError(message)
 

@@ -385,7 +385,11 @@ class _SamplerCore:
             return Counter(self._counts), self._samples
 
     def _run(self) -> None:
-        interval = 1.0 / max(self._hz, 1)
+        # _hz is written under the lock in acquire(); snapshot it under
+        # the same lock (LO203) instead of racing a concurrent first
+        # acquirer's assignment
+        with self._lock:
+            interval = 1.0 / max(self._hz, 1)
         me = threading.get_ident()
         while True:
             with self._lock:

@@ -1,10 +1,11 @@
-"""SPMD-safety analyzer: per-rule fixtures, CLI contract, repo gate.
+"""SPMD-safety + concurrency analyzer: per-rule fixtures, CLI, repo gate.
 
-Every rule family (LO101–LO104) gets at least one positive (bad code
-the rule must flag) and one negative (the nearby good idiom it must NOT
-flag) fixture. The gate at the bottom runs the analyzer over the real
-source trees and asserts zero non-baselined findings — the invariant
-the tentpole exists to enforce on every PR.
+Every rule family (LO101–LO104 SPMD safety, LO201–LO205 concurrency
+hazards) gets at least one positive (bad code the rule must flag), one
+negative (the nearby good idiom it must NOT flag), and one suppressed
+fixture. The gate at the bottom runs the analyzer over the real source
+trees and asserts zero non-baselined findings — the invariant the
+analyzer exists to enforce on every PR.
 """
 
 from __future__ import annotations
@@ -540,6 +541,573 @@ class TestLO104DtypeHygiene:
 
 
 # --------------------------------------------------------------------
+# LO201 — lock acquisition order
+# --------------------------------------------------------------------
+
+
+class TestLO201LockOrder:
+    def test_inconsistent_order_across_methods(self):
+        src = """
+            class S:
+                def a(self):
+                    with self._lock:
+                        with self._io_lock:
+                            pass
+
+                def b(self):
+                    with self._io_lock:
+                        with self._lock:
+                            pass
+        """
+        assert "LO201" in rules_of(src)
+
+    def test_consistent_nesting_is_fine(self):
+        src = """
+            class S:
+                def a(self):
+                    with self._lock:
+                        with self._io_lock:
+                            pass
+
+                def b(self):
+                    with self._lock:
+                        with self._io_lock:
+                            pass
+        """
+        assert rules_of(src) == set()
+
+    def test_self_nesting_flagged(self):
+        src = """
+            def run(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """
+        assert "LO201" in rules_of(src)
+
+    def test_registry_rank_violation(self):
+        # devcache's _TOKEN_LOCK (rank 50) must never be held OUTSIDE
+        # its _GLOBAL_LOCK (rank 40) — the declared cross-module order
+        src = """
+            def mint():
+                with _TOKEN_LOCK:
+                    with _GLOBAL_LOCK:
+                        pass
+        """
+        findings = analyze_source(
+            textwrap.dedent(src),
+            "learningorchestra_tpu/core/devcache.py",
+        )
+        assert any(
+            f.rule == "LO201" and "registry" in f.message for f in findings
+        )
+
+    def test_registry_conformant_nesting_is_fine(self):
+        src = """
+            def mint():
+                with _GLOBAL_LOCK:
+                    with _TOKEN_LOCK:
+                        pass
+        """
+        findings = analyze_source(
+            textwrap.dedent(src),
+            "learningorchestra_tpu/core/devcache.py",
+        )
+        # the nesting edge alone never fires without a reverse edge
+        assert [f for f in findings if f.rule == "LO201"] == []
+
+    def test_non_lock_context_is_not_an_acquisition(self):
+        src = """
+            def run(self):
+                with self._lock:
+                    with span("store:read"):
+                        pass
+                with span("h2d"):
+                    with self._lock:
+                        pass
+        """
+        assert rules_of(src) == set()
+
+    def test_closure_under_lock_resets_context(self):
+        # a def under a with runs later, on its own thread — its
+        # acquisitions are not nested inside the enclosing lock
+        src = """
+            class S:
+                def a(self):
+                    with self._lock:
+                        def later():
+                            with self._io_lock:
+                                with self._lock:
+                                    pass
+                        return later
+        """
+        # later() does nest _io_lock → _lock; but there is no reverse
+        # edge, so nothing fires — the point is the ENCLOSING with does
+        # not create a _lock → _io_lock edge
+        findings = [f for f in findings_for(src) if f.rule == "LO201"]
+        assert findings == [] or all(
+            "self-deadlock" not in f.message for f in findings
+        )
+
+    def test_lock_registry_entries_point_at_real_locks(self):
+        """The declared registry must not rot: every entry names a
+        module that exists in this repo and a lock that module still
+        defines."""
+        from learningorchestra_tpu.analysis.concurrency import LOCK_REGISTRY
+
+        package_root = os.path.join(_REPO_ROOT, "learningorchestra_tpu")
+        for (suffix, lock), rank in LOCK_REGISTRY.items():
+            assert isinstance(rank, int)
+            path = os.path.join(package_root, *suffix.split("/"))
+            assert os.path.isfile(path), f"registry names missing {suffix}"
+            with open(path, encoding="utf-8") as handle:
+                assert lock in handle.read(), (
+                    f"{suffix} no longer defines {lock}"
+                )
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            def run(self):
+                with self._lock:
+                    with self._lock:  # lo: allow[LO201]
+                        pass
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO202 — blocking calls under a held lock
+# --------------------------------------------------------------------
+
+
+class TestLO202BlockingUnderLock:
+    def test_sleep_under_lock(self):
+        src = """
+            import time
+
+            def run(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_network_call_under_lock(self):
+        src = """
+            import requests
+
+            def probe(self, url):
+                with self._lock:
+                    return requests.get(url, timeout=2)
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_store_wire_call_under_lock(self):
+        # the PR 7 shape: a registry lock held across a checkpoint /
+        # store operation stalls every status probe behind it
+        src = """
+            def finalize(self, store, collection, error):
+                with self._lock:
+                    store.update_one(collection, {"_id": 0}, {"e": error})
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_checkpoint_load_under_lock(self):
+        src = """
+            def get(self, path):
+                with self._lock:
+                    return load_model(path, mesh=self._mesh)
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_thread_join_under_lock(self):
+        src = """
+            def stop(self):
+                with role["lock"]:
+                    self._thread.join()
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_worker_stop_under_lock(self):
+        # the promote_role bug this PR fixed: poller.stop() (a thread
+        # join bounded only by the poll timeout) under role["lock"]
+        src = """
+            def promote(self, role):
+                with role["lock"]:
+                    poller = role.get("poller")
+                    if poller is not None:
+                        poller.stop()
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_unbounded_queue_get_under_lock(self):
+        src = """
+            def drain(self):
+                with self._lock:
+                    item = self._queue.get()
+        """
+        assert "LO202" in rules_of(src)
+
+    def test_string_and_path_join_are_fine(self):
+        src = """
+            import os
+
+            def render(self):
+                with self._lock:
+                    text = ", ".join(self._parts)
+                    path = os.path.join(self._root, "x")
+                return text, path
+        """
+        assert rules_of(src) == set()
+
+    def test_condvar_wait_on_held_lock_is_not_lo202(self):
+        # waiting on the held lock's own condition RELEASES it — that
+        # is LO204's discipline, not a blocking hazard
+        src = """
+            def pop(self):
+                with self.cond:
+                    while not self.items:
+                        self.cond.wait(1.0)
+                    return self.items.pop()
+        """
+        assert rules_of(src) == set()
+
+    def test_bounded_foreign_wait_is_fine(self):
+        src = """
+            def submit(self, done):
+                with self._lock:
+                    done.wait(30.0)
+        """
+        assert rules_of(src) == set()
+
+    def test_self_store_methods_exempt(self):
+        # the in-memory store's re-entrant internal calls under its own
+        # RLock are its design, not a wire round trip
+        src = """
+            def insert_many(self, collection, documents):
+                with self._lock:
+                    for document in documents:
+                        self.insert_one(collection, document)
+        """
+        assert rules_of(src) == set()
+
+    def test_blocking_call_outside_lock_is_fine(self):
+        src = """
+            import time
+
+            def run(self):
+                with self._lock:
+                    payload = self._next()
+                time.sleep(0.1)
+                return payload
+        """
+        assert rules_of(src) == set()
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            def apply(self, records):
+                with self._apply_lock:
+                    self.store.apply_replicated(records)  # lo: allow[LO202]
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO203 — unguarded shared state (lockset-lite)
+# --------------------------------------------------------------------
+
+
+class TestLO203UnguardedSharedState:
+    def test_wait_snapshot_race_shape(self):
+        # THE golden case (PR 3, core/jobs.py): wait() read the maps
+        # without the lock that every writer holds — a concurrent
+        # re-registration paired the old event with the new record
+        src = """
+            class JobManager:
+                def register(self, name, record):
+                    with self._lock:
+                        self._jobs[name] = record
+
+                def wait(self, name):
+                    return self._jobs[name]
+        """
+        assert "LO203" in rules_of(src)
+
+    def test_bare_write_flagged_too(self):
+        # the batcher-counter shape: written bare on the worker thread,
+        # read under the lock by stats()
+        src = """
+            class B:
+                def work(self):
+                    self.batches += 1
+
+                def stats(self):
+                    with self._lock:
+                        return self.batches
+        """
+        assert "LO203" in rules_of(src)
+
+    def test_snapshot_under_lock_is_fine(self):
+        src = """
+            class JobManager:
+                def register(self, name, record):
+                    with self._lock:
+                        self._jobs[name] = record
+
+                def wait(self, name):
+                    with self._lock:
+                        return self._jobs[name]
+        """
+        assert rules_of(src) == set()
+
+    def test_locked_suffix_convention(self):
+        # the codebase's _locked idiom: the helper's name IS the
+        # caller-holds-the-lock contract
+        src = """
+            class Cache:
+                def put(self, key, value):
+                    with self._lock:
+                        self._drop_locked(key)
+                        self._entries[key] = value
+
+                def _drop_locked(self, key):
+                    self._entries.pop(key, None)
+        """
+        assert rules_of(src) == set()
+
+    def test_init_writes_exempt(self):
+        src = """
+            class Cache:
+                def __init__(self):
+                    self._entries = {}
+
+                def put(self, key, value):
+                    with self._lock:
+                        self._entries[key] = value
+        """
+        assert rules_of(src) == set()
+
+    def test_read_only_config_attr_is_fine(self):
+        src = """
+            class Cache:
+                def put(self, key, nbytes):
+                    with self._lock:
+                        if nbytes <= self.capacity:
+                            self._entries[key] = nbytes
+
+                def fits(self, nbytes):
+                    return nbytes <= self.capacity
+        """
+        assert rules_of(src) == set()
+
+    def test_lock_attributes_themselves_exempt(self):
+        src = """
+            class S:
+                def a(self):
+                    with self._lock:
+                        self._items.append(1)
+
+                def lock_for_tests(self):
+                    return self._lock
+        """
+        assert rules_of(src) == set()
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            class D:
+                def mark(self, reason):
+                    with self._lock:
+                        self._poisoned = reason
+
+                def fast_path(self):
+                    return self._poisoned  # lo: allow[LO203]
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO204 — condition-variable discipline
+# --------------------------------------------------------------------
+
+
+class TestLO204CondvarDiscipline:
+    def test_wait_outside_predicate_loop(self):
+        src = """
+            def take(self):
+                with self.cond:
+                    self.cond.wait(1.0)
+                    return self.items.pop()
+        """
+        assert "LO204" in rules_of(src)
+
+    def test_wait_without_timeout(self):
+        src = """
+            def take(self):
+                with self.cond:
+                    while not self.items:
+                        self.cond.wait()
+                    return self.items.pop()
+        """
+        assert "LO204" in rules_of(src)
+
+    def test_disciplined_wait_is_fine(self):
+        src = """
+            def take(self):
+                with self.cond:
+                    while not self.items:
+                        self.cond.wait(1.0)
+                    return self.items.pop()
+        """
+        assert rules_of(src) == set()
+
+    def test_deadline_loop_with_timeout_is_fine(self):
+        # the sync-repl ack shape: while True + internal deadline
+        # checks IS a predicate loop
+        src = """
+            def await_shipped(self, cv, deadline):
+                import time
+
+                with cv:
+                    while True:
+                        if self.shipped:
+                            return True
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return False
+                        cv.wait(remaining)
+        """
+        assert rules_of(src) == set()
+
+    def test_notify_outside_lock(self):
+        src = """
+            def publish(self, item):
+                self.items.append(item)
+                self.cond.notify_all()
+        """
+        assert "LO204" in rules_of(src)
+
+    def test_notify_under_lock_is_fine(self):
+        src = """
+            def publish(self, item):
+                with self.cond:
+                    self.items.append(item)
+                    self.cond.notify_all()
+        """
+        assert rules_of(src) == set()
+
+    def test_event_wait_is_not_a_condvar(self):
+        src = """
+            def run_sync(self, done):
+                done.wait()
+        """
+        assert rules_of(src) == set()
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            def take(self):
+                with self.cond:
+                    self.cond.wait(1.0)  # lo: allow[LO204]
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO205 — torn publish across lock scopes
+# --------------------------------------------------------------------
+
+
+class TestLO205TornPublish:
+    def test_same_attr_mutated_in_two_scopes(self):
+        # the _finalize/DELETE shape (PR 3): record and task published
+        # under separate acquisitions let a cancel() between them 202 a
+        # cancellation that never flips the token
+        src = """
+            class M:
+                def publish(self, name, record, task):
+                    with self._lock:
+                        self._records[name] = record
+                    self._journal(name)
+                    with self._lock:
+                        self._records[name] = task
+        """
+        assert "LO205" in rules_of(src)
+
+    def test_mutating_method_calls_count(self):
+        src = """
+            class M:
+                def rotate(self, name):
+                    with self._lock:
+                        self._tasks.pop(name, None)
+                    with self._lock:
+                        self._tasks.update({name: 1})
+        """
+        assert "LO205" in rules_of(src)
+
+    def test_one_finding_per_attr_not_per_block(self):
+        src = """
+            class M:
+                def publish(self, name):
+                    with self._lock:
+                        self._records[name] = 1
+                    with self._lock:
+                        self._records[name] = 2
+                    with self._lock:
+                        self._records[name] = 3
+        """
+        assert sum(f.rule == "LO205" for f in findings_for(src)) == 1
+
+    def test_disjoint_attrs_are_fine(self):
+        # the registry.get shape: counters in the probe scope, entries
+        # in the publish scope — no attr spans both
+        src = """
+            class R:
+                def get(self, key):
+                    with self._lock:
+                        self.misses += 1
+                    value = self._load(key)
+                    with self._lock:
+                        self._entries[key] = value
+                    return value
+        """
+        assert rules_of(src) == set()
+
+    def test_reads_between_scopes_are_fine(self):
+        src = """
+            class R:
+                def stats(self):
+                    with self._lock:
+                        count = len(self._entries)
+                    with self._lock:
+                        return count + len(self._entries)
+        """
+        assert rules_of(src) == set()
+
+    def test_different_methods_not_torn(self):
+        src = """
+            class R:
+                def a(self):
+                    with self._lock:
+                        self._entries["a"] = 1
+
+                def b(self):
+                    with self._lock:
+                        self._entries["b"] = 2
+        """
+        assert rules_of(src) == set()
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            class M:
+                def publish(self, name, record, task):
+                    with self._lock:
+                        self._records[name] = record
+                    self._journal(name)
+                    with self._lock:  # lo: allow[LO205]
+                        self._records[name] = task
+        """
+        assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
 # CLI contract + baseline workflow
 # --------------------------------------------------------------------
 
@@ -570,6 +1138,45 @@ _BAD_BY_RULE = {
         "import numpy as np\n"
         "def fn(v):\n"
         "    return jnp.asarray(v, dtype=np.float64)\n"
+    ),
+    "LO201": (
+        "class S:\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            with self._io_lock:\n"
+        "                pass\n"
+        "    def b(self):\n"
+        "        with self._io_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    ),
+    "LO202": (
+        "import time\n"
+        "def run(self):\n"
+        "    with self._lock:\n"
+        "        time.sleep(1.0)\n"
+    ),
+    "LO203": (
+        "class M:\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._jobs[k] = v\n"
+        "    def wait(self, k):\n"
+        "        return self._jobs[k]\n"
+    ),
+    "LO204": (
+        "def take(self):\n"
+        "    with self.cond:\n"
+        "        self.cond.wait(1.0)\n"
+    ),
+    "LO205": (
+        "class M:\n"
+        "    def publish(self, name, a, b):\n"
+        "        with self._lock:\n"
+        "            self._records[name] = a\n"
+        "        log(name)\n"
+        "        with self._lock:\n"
+        "            self._records[name] = b\n"
     ),
 }
 
@@ -811,6 +1418,165 @@ class TestBaselineWorkflow:
             '    dispatcher.submit("op", {"stamp": time.time()})\n'
         )
         assert cli_main([str(path), "--baseline", str(baseline)]) == 1
+
+
+class TestRuleMeta:
+    """Meta-invariants over the rule registry and its documentation."""
+
+    def test_every_rule_documented(self):
+        """Every rule id — LO2xx included — appears in docs/analysis.md
+        (the table a suppression comment points reviewers at)."""
+        from learningorchestra_tpu.analysis.rules import RULES
+
+        with open(
+            os.path.join(_REPO_ROOT, "docs", "analysis.md"),
+            encoding="utf-8",
+        ) as handle:
+            docs = handle.read()
+        for rule_id in RULES:
+            assert rule_id in docs, f"{rule_id} missing from docs/analysis.md"
+
+    def test_every_rule_listed_by_cli(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        from learningorchestra_tpu.analysis.rules import RULES
+
+        for rule_id in RULES:
+            assert rule_id in out
+
+    def test_lo2xx_baseline_round_trip(self, tmp_path, capsys):
+        """The baseline workflow holds for the concurrency family: a
+        grandfathered LO2xx finding stops failing, a NEW instance of
+        the same pattern still fails, and regenerating the baseline
+        from a fixed tree leaves it empty."""
+        path = tmp_path / "legacy.py"
+        path.write_text(_BAD_BY_RULE["LO203"])
+        baseline = tmp_path / "baseline.txt"
+        assert (
+            cli_main(
+                [str(path), "--baseline", str(baseline), "--write-baseline"]
+            )
+            == 0
+        )
+        capsys.readouterr()
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 0
+        assert "baselined" in capsys.readouterr().out
+
+        # a second unguarded access is a NEW finding despite the baseline
+        path.write_text(
+            _BAD_BY_RULE["LO203"]
+            + "    def peek(self, k):\n"
+            "        return self._jobs.get(k)\n"
+        )
+        assert cli_main([str(path), "--baseline", str(baseline)]) == 1
+
+        # fix the file, regenerate: the baseline empties out — the
+        # ISSUE 9 contract (findings get fixed, not grandfathered)
+        path.write_text(
+            "class M:\n"
+            "    def put(self, k, v):\n"
+            "        with self._lock:\n"
+            "            self._jobs[k] = v\n"
+            "    def wait(self, k):\n"
+            "        with self._lock:\n"
+            "            return self._jobs[k]\n"
+        )
+        assert (
+            cli_main(
+                [str(path), "--baseline", str(baseline), "--write-baseline"]
+            )
+            == 0
+        )
+        content = [
+            line
+            for line in baseline.read_text().splitlines()
+            if line and not line.startswith("#")
+        ]
+        assert content == []
+
+
+class TestChangedMode:
+    """--changed: only findings new since the git merge-base fail."""
+
+    @pytest.fixture()
+    def repo(self, tmp_path, monkeypatch):
+        def git(*args):
+            subprocess.run(
+                ["git", *args],
+                cwd=tmp_path,
+                check=True,
+                capture_output=True,
+                env={
+                    **os.environ,
+                    "GIT_AUTHOR_NAME": "t",
+                    "GIT_AUTHOR_EMAIL": "t@t",
+                    "GIT_COMMITTER_NAME": "t",
+                    "GIT_COMMITTER_EMAIL": "t@t",
+                },
+            )
+
+        git("init", "-b", "main")
+        (tmp_path / "legacy.py").write_text(_BAD_MODULE)
+        git("add", "-A")
+        git("commit", "-m", "seed")
+        git("checkout", "-b", "feature")
+        monkeypatch.chdir(tmp_path)
+        return tmp_path
+
+    def test_preexisting_findings_pass_new_ones_fail(self, repo, capsys):
+        # the merge-base's LO102 finding is grandfathered...
+        assert cli_main(["--changed", "legacy.py"]) == 0
+        assert "baselined" in capsys.readouterr().out
+        # ...but a finding introduced on the branch fails
+        (repo / "legacy.py").write_text(
+            _BAD_MODULE
+            + "\ndef more(dispatcher):\n"
+            "    dispatcher.submit(\"op\", {\"t\": time.time()})\n"
+        )
+        assert cli_main(["--changed", "legacy.py"]) == 1
+
+    def test_new_file_findings_all_fail(self, repo):
+        (repo / "fresh.py").write_text(_BAD_MODULE)
+        assert cli_main(["--changed", "fresh.py"]) == 1
+
+    def test_fixed_file_is_clean(self, repo, capsys):
+        (repo / "legacy.py").write_text("def fn():\n    return 1\n")
+        assert cli_main(["--changed", "legacy.py"]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_explicit_ref(self, repo):
+        assert cli_main(["--changed", "--base", "main", "legacy.py"]) == 0
+
+    def test_base_without_changed_is_usage_error(self, repo, capsys):
+        assert cli_main(["--base", "main", "legacy.py"]) == 2
+        assert "--base" in capsys.readouterr().err
+
+    def test_unknown_ref_is_usage_error(self, repo, capsys):
+        assert cli_main(["--changed", "--base", "nope", "legacy.py"]) == 2
+        assert "--changed" in capsys.readouterr().err
+
+    def test_outside_git_repo_is_usage_error(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        outside = tmp_path / "plain"
+        outside.mkdir()
+        (outside / "x.py").write_text("pass\n")
+        monkeypatch.chdir(outside)
+        monkeypatch.setenv("GIT_CEILING_DIRECTORIES", str(tmp_path))
+        assert cli_main(["--changed", "x.py"]) == 2
+        assert "--changed" in capsys.readouterr().err
+
+    def test_changed_with_baseline_refused(self, repo):
+        (repo / "baseline.txt").write_text("")
+        assert (
+            cli_main(
+                ["--changed", "--baseline", "baseline.txt", "legacy.py"]
+            )
+            == 2
+        )
+        assert (
+            cli_main(["--changed", "--write-baseline", "legacy.py"]) == 2
+        )
 
 
 # --------------------------------------------------------------------

@@ -49,6 +49,66 @@ class TestBuildModel:
             assert isinstance(rows[0]["probability"], list)
             assert "features" not in rows[0]
 
+    def test_two_warm_builds_complete_concurrently(self, titanic_store):
+        """Regression for the PR 8 KNOWN LATENT: on the 8-virtual-device
+        CPU backend, two warm builds running their collective evals
+        concurrently used to deadlock XLA's CPU rendezvous (each
+        program's participants holding part of the host thread pool,
+        waiting on peers the other program occupies). The
+        _collective_dispatch_guard in ml/builder.py now serializes
+        those dispatches on single-process CPU, so two concurrent
+        builds must COMPLETE — and agree with each other."""
+        import threading
+
+        # warm build: compiles every program so the concurrent pair
+        # below executes already-compiled collectives (the deadlock's
+        # trigger condition)
+        build_model(
+            titanic_store,
+            "titanic_train",
+            "titanic_test",
+            DOCUMENTED_PREPROCESSOR,
+            ["lr", "nb", "dt"],
+        )
+        results: dict = {}
+
+        def run(slot: str) -> None:
+            try:
+                results[slot] = build_model(
+                    titanic_store,
+                    "titanic_train",
+                    "titanic_test",
+                    DOCUMENTED_PREPROCESSOR,
+                    ["lr", "nb", "dt"],
+                    # the second build writes to a distinct prediction
+                    # namespace only through timing; writing outputs
+                    # from both is fine (same collections, drop+insert)
+                )
+            except BaseException as error:  # noqa: BLE001 — asserted below
+                results[slot] = error
+
+        threads = [
+            threading.Thread(target=run, args=(slot,), daemon=True)
+            for slot in ("a", "b")
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            # generous bound: a deadlock parks forever, a healthy pair
+            # of warm 3-classifier builds takes seconds
+            thread.join(timeout=300)
+        assert not any(t.is_alive() for t in threads), (
+            "concurrent warm builds did not complete — the CPU "
+            "rendezvous guard regressed"
+        )
+        for slot in ("a", "b"):
+            assert not isinstance(results[slot], BaseException), results[slot]
+            assert {r["classificator"] for r in results[slot]} == {
+                "lr",
+                "nb",
+                "dt",
+            }
+
     def test_invalid_classifier_raises(self, titanic_store):
         with pytest.raises(KeyError):
             build_model(

@@ -215,7 +215,10 @@ class TestSlice:
 
 class TestUniqueCounts:
     def _as_pairs(self, groups):
-        return {(g["_id"] if not isinstance(g["_id"], bool) else ("b", g["_id"])): g["count"] for g in groups}
+        return {
+            (g["_id"] if not isinstance(g["_id"], bool) else ("b", g["_id"])): g["count"]
+            for g in groups
+        }
 
     def test_int_counts(self):
         col = Column.from_values([3, 1, 3, 3])
