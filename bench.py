@@ -26,6 +26,11 @@ Four sections, all on the visible chip(s):
    (docs/serving.md) at 1 / 8 / 64 concurrent clients — p50/p99
    latency, predictions/s, achieved mean batch size
    (``LO_BENCH_SERVE_REQUESTS`` per client, default 100).
+6. **Coalesce**: the job coalescer (docs/scheduler.md) under a burst of
+   64 concurrent small builds — jobs/s with coalescing on vs
+   ``LO_COALESCE_WINDOW_MS=0`` off, achieved mean batch size — plus a
+   100-point λ sweep as ONE fused dispatch vs 100 sequential
+   estimator fits.
 
 Prints exactly ONE JSON line: the headline kernel metric (metric/value/
 unit/vs_baseline, same name as previous rounds) with everything else
@@ -578,6 +583,135 @@ def bench_serve() -> dict:
         shutil.rmtree(models_dir, ignore_errors=True)
 
 
+def bench_coalesce() -> dict:
+    """Coalesce section: the scheduler's vmap-across-jobs stage
+    (sched/coalesce.py) under the ISSUE's two workloads. Both flood
+    arms run the SAME batched runner (ml/sweep.py) through real
+    JobManager device jobs — the only difference is the window knob —
+    while the sweep arm compares one fused grid dispatch against the
+    honest baseline of 100 sequential product-estimator fits."""
+    import threading
+
+    from learningorchestra_tpu.core.jobs import JobManager
+    from learningorchestra_tpu.ml import sweep as lo_sweep
+    from learningorchestra_tpu.ml.base import resolve_mesh
+    from learningorchestra_tpu.ml.logistic import LogisticRegression
+    from learningorchestra_tpu.sched.coalesce import Coalescer
+    from learningorchestra_tpu.sched.scheduler import DEVICE_CLASS, Scheduler
+
+    rows = int(os.environ.get("LO_BENCH_COALESCE_ROWS", "1024"))
+    max_iter = 25
+    n_jobs = 64
+    X, y = _synthetic(rows, seed=7)
+    mesh = resolve_mesh(None)
+    runner = lo_sweep.group_runner(mesh)
+    key, payload = lo_sweep.prepare_member(
+        "lr", X, y, X, y, [{"reg_param": 0.0}], mesh=mesh, max_iter=max_iter
+    )
+
+    # Warm both fused program shapes this section dispatches (the
+    # 8-slot floor the window-0 arm runs and the 64-slot batch the
+    # coalesced arm runs): every timed number in this suite is a warm
+    # measurement (see main()'s compile-cache note), so compiles must
+    # not decide the comparison — in production the shape grid means a
+    # batch width compiles once, ever.
+    lo_sweep.run_group([payload], mesh)
+    lo_sweep.run_group([payload] * n_jobs, mesh)
+
+    def flood(window_s: float) -> dict:
+        jobs = JobManager(scheduler=Scheduler(queue_cap=2 * n_jobs))
+        coalescer = Coalescer(window_s=window_s, max_jobs=n_jobs)
+        barrier = threading.Barrier(n_jobs + 1)
+        failures: list = []
+
+        def client(index: int) -> None:
+            member = coalescer.register(
+                key, payload, runner, name=f"co-{index}"
+            )
+            barrier.wait()
+            try:
+                jobs.run_sync(
+                    f"co-{window_s}-{index}",
+                    coalescer.run_member,
+                    member,
+                    job_class=DEVICE_CLASS,
+                )
+            except Exception as error:  # noqa: BLE001 — surfaced below
+                failures.append(error)
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(n_jobs)
+        ]
+        for thread in threads:
+            thread.start()
+        barrier.wait()
+        start = time.perf_counter()
+        for thread in threads:
+            thread.join()
+        elapsed = time.perf_counter() - start
+        stats = coalescer.stats()
+        jobs.scheduler.close()
+        if failures:
+            raise RuntimeError(
+                f"{len(failures)}/{n_jobs} coalesced jobs failed: "
+                f"{failures[0]!r}"
+            )
+        return {
+            "jobs_per_s": round(n_jobs / elapsed, 2),
+            "wall_s": round(elapsed, 4),
+            "fused_dispatches": stats["fused_dispatches"],
+            "mean_batch_size": stats["mean_batch_size"],
+        }
+
+    coalesced = flood(0.010)
+    uncoalesced = flood(0.0)
+    out: dict = {
+        "jobs": n_jobs,
+        "rows": rows,
+        "coalesced": coalesced,
+        "uncoalesced_window0": uncoalesced,
+        "coalesce_speedup": round(
+            coalesced["jobs_per_s"] / uncoalesced["jobs_per_s"], 2
+        ),
+    }
+
+    if _budget_left() < 60:
+        out["sweep_100"] = {"skipped": "budget"}
+        return out
+    # The sweep arm at small-build scale (its own knob): fit + evaluate
+    # 100 λ points as ONE fused dispatch vs the STRICTEST sequential
+    # baseline — 100 bare product-estimator fits, each evaluated, no
+    # REST/store overhead charged to either side.
+    sweep_rows = int(os.environ.get("LO_BENCH_SWEEP_ROWS", "256"))
+    X_s, y_s = _synthetic(sweep_rows, seed=9)
+    grid = [{"reg_param": float(v)} for v in np.linspace(0.0, 1.0, 100)]
+    key100, payload100 = lo_sweep.prepare_member(
+        "lr", X_s, y_s, X_s, y_s, grid, mesh=mesh, max_iter=max_iter
+    )
+    # warm both arms' programs (the grid's padded width for the fused
+    # arm, the solo estimator's programs for the sequential arm)
+    lo_sweep.run_group([payload100], mesh)
+    LogisticRegression(
+        max_iter=max_iter, reg_param=0.0, mesh=mesh
+    ).fit(X_s, y_s).evaluate(X_s, y_s)
+    fused_s = _best_of(lambda: lo_sweep.run_group([payload100], mesh))
+    start = time.perf_counter()
+    for point in grid:
+        model = LogisticRegression(
+            max_iter=max_iter, reg_param=point["reg_param"], mesh=mesh
+        ).fit(X_s, y_s)
+        model.evaluate(X_s, y_s)
+    sequential_s = time.perf_counter() - start
+    out["sweep_100"] = {
+        "points": len(grid),
+        "rows": sweep_rows,
+        "fused_s": round(fused_s, 3),
+        "sequential_s": round(sequential_s, 3),
+        "sweep_speedup": round(sequential_s / fused_s, 2),
+    }
+    return out
+
+
 def bench_embeddings() -> dict:
     """Section 3: the PCA + t-SNE north-star wall-clocks."""
     from learningorchestra_tpu.ops.pca import pca_embedding
@@ -1020,6 +1154,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
     # the product-path or embeddings measurements.
     section("product_path", lambda: bench_product(X, y))
     section("serve", bench_serve)  # the online predict lane's latency
+    section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch
     section("embeddings", bench_embeddings)
     section("kernels_wide", bench_kernels_wide)
 

@@ -51,6 +51,10 @@ Manifest (JSON)::
         "store_compress": 0,       #   LO_STORE_COMPRESS (1 = zlib wire)
         "write_overlap": 1         #   LO_WRITE_OVERLAP (0 = sync writes)
       },
+      "coalescing": {              # optional job-coalescing knobs
+        "window_ms": 2,            #   LO_COALESCE_WINDOW_MS (>= 0;
+        "max_jobs": 32             #   0 = passthrough) / LO_COALESCE_
+      },                           #   MAX_JOBS (integer >= 1)
       "serving": {                 # optional online-serving knobs
         "serve_bytes": 1000000000, #   LO_SERVE_BYTES (0 = host fallback)
         "batch_window_ms": 1,      #   LO_SERVE_BATCH_WINDOW_MS (>= 0)
@@ -156,6 +160,25 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("dataplane.devcache_bytes must be >= 0")
         elif value not in (0, 1):
             raise SystemExit(f"dataplane.{key} must be 0 or 1")
+    coalescing = manifest.setdefault("coalescing", {})
+    for key in coalescing:
+        if key not in _COALESCING_KNOBS:
+            raise SystemExit(
+                f"unknown coalescing knob {key!r} (have: "
+                f"{', '.join(sorted(_COALESCING_KNOBS))})"
+            )
+        value = coalescing[key]
+        # same bool-is-int trap as the sched/serving knobs: JSON true
+        # would stringify to "True" and fail every preflight downstream
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SystemExit(f"coalescing.{key} must be a number")
+        if key == "max_jobs":
+            if not isinstance(value, int) or value < 1:
+                raise SystemExit(
+                    "coalescing.max_jobs must be an integer >= 1"
+                )
+        elif value < 0:  # window_ms: 0 = passthrough, still valid
+            raise SystemExit("coalescing.window_ms must be >= 0")
     serving = manifest.setdefault("serving", {})
     for key in serving:
         if key not in _SERVING_KNOBS:
@@ -257,6 +280,15 @@ _DATAPLANE_KNOBS = {
     "write_overlap": "LO_WRITE_OVERLAP",
 }
 
+# manifest coalescing.<knob> -> the env var every machine receives
+# (docs/scheduler.md). Cluster-wide: coalescing keys include the mesh
+# signature, and a per-host window skew would make "the same flood"
+# fuse on one machine and serialize on another.
+_COALESCING_KNOBS = {
+    "window_ms": "LO_COALESCE_WINDOW_MS",
+    "max_jobs": "LO_COALESCE_MAX_JOBS",
+}
+
 # manifest serving.<knob> -> the env var every machine receives
 # (docs/serving.md). Only the head serves REST today, but the knobs go
 # cluster-wide like the others: a failover promotion or a future
@@ -331,6 +363,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _DATAPLANE_KNOBS.items():
         if knob in manifest.get("dataplane", {}):
             shared[env_var] = str(manifest["dataplane"][knob])
+    for knob, env_var in _COALESCING_KNOBS.items():
+        if knob in manifest.get("coalescing", {}):
+            shared[env_var] = str(manifest["coalescing"][knob])
     for knob, env_var in _SERVING_KNOBS.items():
         if knob in manifest.get("serving", {}):
             shared[env_var] = str(manifest["serving"][knob])

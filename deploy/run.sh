@@ -23,6 +23,12 @@
 #                         SPMD dispatches never contend for the mesh)
 #   LO_SCHED_QUEUE_CAP    per-class queue cap; past it submissions get
 #                         HTTP 429 + Retry-After         (default 64)
+#   LO_COALESCE_WINDOW_MS job-coalescing collection window in ms; shape-
+#                         compatible device jobs arriving within it fuse
+#                         into ONE vmap-across-jobs dispatch (default 2;
+#                         0 = passthrough, every job dispatches alone)
+#   LO_COALESCE_MAX_JOBS  max member jobs per fused dispatch (default 32,
+#                         strictly integral)
 #
 # Data-plane knobs (docs/dataplane.md has the full table):
 #   LO_DEVCACHE_BYTES     rev-keyed device-cache capacity in bytes
@@ -79,6 +85,9 @@ python - <<'EOF'
 import os
 from learningorchestra_tpu.sched import config
 config.host_width(); config.device_width(); config.queue_cap()
+# coalescing knobs: window >= 0 (0 = passthrough), max_jobs a strict
+# integer >= 1 (1.5 silently truncating would halve every fused batch)
+config.coalesce_window_s(); config.coalesce_max_jobs()
 from learningorchestra_tpu.core import devcache
 devcache.capacity_bytes()
 # serving knobs: reject non-numeric / out-of-range before bring-up

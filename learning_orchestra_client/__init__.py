@@ -12,7 +12,10 @@ runs against the TPU framework with only the cluster IP changed.
 Beyond the reference surface, ``Model`` additionally exposes the online
 serving lane (``Model.predict(model_name, rows)`` /
 ``Model.list_models()`` → ``POST /models/<name>/predict`` — synchronous
-labels + probabilities, no polling; docs/serving.md).
+labels + probabilities, no polling; docs/serving.md) and hyperparameter
+sweeps (``Model.sweep(..., grid, sweep_name)`` → ``POST /models/sweep``
+— a λ/depth grid fitted as ONE fused device dispatch, per-point metrics
+plus the argmax checkpoint; docs/model_builder.md).
 """
 
 from learningorchestra_tpu.client import (  # noqa: F401

@@ -348,3 +348,36 @@ class Model(_RestClient):
         if pretty_response:
             _banner(" LIST MODELS ")
         return self._get(pretty_response=pretty_response)
+
+    def sweep(
+        self,
+        training_filename,
+        test_filename,
+        preprocessor_code,
+        classificator,
+        grid,
+        sweep_name,
+        max_iter=None,
+        pretty_response: bool = True,
+    ):
+        """Hyperparameter sweep in ONE device dispatch (``POST
+        /models/sweep``): ``grid`` is a list of points — ``[{"reg_param":
+        0.1}, ...]`` for ``classificator="lr"``, ``[{"max_depth": 3},
+        ...]`` for ``"dt"``. Returns per-point metrics, the argmax
+        index, and the checkpoint name ``sweep_name`` — immediately
+        servable via :meth:`predict`."""
+        if pretty_response:
+            _banner(" SWEEP " + classificator + " AS " + sweep_name + " ")
+        self._wait_finished(training_filename, pretty_response)
+        self._wait_finished(test_filename, pretty_response)
+        body = {
+            "training_filename": training_filename,
+            "test_filename": test_filename,
+            "preprocessor_code": preprocessor_code,
+            "classificator": classificator,
+            "grid": grid,
+            "sweep_name": sweep_name,
+        }
+        if max_iter is not None:
+            body["max_iter"] = max_iter
+        return self._post("sweep", body=body, pretty_response=pretty_response)

@@ -177,6 +177,7 @@ class JobManager:
         priority: int = 0,
         timeout: Optional[float] = None,
         replay: Optional[tuple[str, dict]] = None,
+        token: Optional[CancelToken] = None,
         **kwargs,
     ) -> JobRecord:
         """Admit ``fn`` into ``job_class``'s queue. If ``store``/
@@ -184,7 +185,11 @@ class JobManager:
         dataset's metadata ``finished: true`` with an ``error`` field so
         pollers terminate instead of hanging. ``replay=(op, payload)``
         journals enough lineage for a restarted process to re-enqueue
-        the job if it never started (sched/recovery.py).
+        the job if it never started (sched/recovery.py). ``token``
+        injects a caller-held :class:`CancelToken` — the coalescing
+        stage needs the token visible on the member BEFORE the task
+        exists, so a leader can mask a cancelled member out of its
+        fused dispatch (sched/coalesce.py).
 
         Raises :class:`DuplicateJobError` if ``name`` is active and
         :class:`QueueFullError` (→ HTTP 429) at the class's queue cap.
@@ -200,6 +205,7 @@ class JobManager:
             priority,
             timeout,
             replay,
+            token=token,
         )
         return record
 
@@ -217,6 +223,7 @@ class JobManager:
         replay: Optional[tuple[str, dict]],
         keep_exception: bool = False,
         journaled: bool = True,
+        token: Optional[CancelToken] = None,
     ) -> tuple[JobRecord, threading.Event]:
         # Cheap rejection first: a flood past the cap must not pay the
         # journal's store writes per rejected request (enqueue below
@@ -224,9 +231,12 @@ class JobManager:
         self._scheduler.check_admission(job_class)
         if timeout is None:
             timeout = self._default_timeout_s
-        token = CancelToken(
-            deadline=time.monotonic() + timeout if timeout else None
-        )
+        if token is None:
+            token = CancelToken(
+                deadline=time.monotonic() + timeout if timeout else None
+            )
+        elif token.deadline is None and timeout:
+            token.deadline = time.monotonic() + timeout
         op, payload = replay if replay is not None else (None, None)
         record = JobRecord(
             name=name,
@@ -307,6 +317,7 @@ class JobManager:
         priority: int = 0,
         timeout: Optional[float] = None,
         replay: Optional[tuple[str, dict]] = None,
+        token: Optional[CancelToken] = None,
         **kwargs,
     ) -> JobRecord:
         """Submit and block until terminal; re-raise the job's own
@@ -327,6 +338,7 @@ class JobManager:
             timeout,
             replay,
             keep_exception=True,
+            token=token,
             # the caller waits and sees the failure directly; without a
             # replay op or a polled collection the journal could only
             # ever mark this 'orphaned' at restart — skip the writes

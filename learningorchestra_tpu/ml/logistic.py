@@ -94,8 +94,13 @@ def _lbfgs_state(params):
         "filled": jnp.int32(0),     # valid pair count (<= m)
         "value": jnp.float32(0.0),  # f(x) at the current point
         "grad": jax.tree.map(jnp.zeros_like, params),
-        "fresh": jnp.bool_(True),   # value/grad not yet computed
     }
+    # value/grad are (re)seeded at each segment's entry (_fit_segment)
+    # rather than lazily via a lax.cond inside the first iteration: the
+    # cond read nicely but under the sweep module's vmap-across-jobs a
+    # BATCHED cond executes both branches, paying a full extra
+    # value_and_grad pass every L-BFGS step; one seeding pass per
+    # segment (25+ iterations) costs ~4% instead
 
 
 def _two_loop(state):
@@ -141,17 +146,17 @@ def _fit_segment(params, opt_state, X, y, mask, iters: int, l2):
     same iteration sequence as the former single-scan program."""
     loss = partial(_loss_fn, X=X, y=y, mask=mask, l2=l2)
     value_and_grad = jax.value_and_grad(loss)
+    # seed (value, grad) at the segment's entry point: recomputing the
+    # carried pair is redundant-but-identical work once per segment,
+    # and it keeps every scan iteration branch-free (see _lbfgs_state)
+    value0, grad0 = value_and_grad(params)
+    opt_state = {**opt_state, "value": value0, "grad": grad0}
 
     def step(carry, _):
         x, state = carry
-        value, grad = jax.lax.cond(
-            state["fresh"],
-            lambda: value_and_grad(x),
-            lambda: (state["value"], state["grad"]),
-        )
-        state = {
-            **state, "value": value, "grad": grad, "fresh": jnp.bool_(False)
-        }
+        # (value, grad) at x: seeded above for the first iteration,
+        # then carried from each accepted point's value_and_grad below
+        value, grad = state["value"], state["grad"]
         direction = _two_loop(state)
         slope = _tree_dot(grad, direction)
         # safeguard: a non-descent direction (stale curvature) falls
@@ -345,6 +350,16 @@ def _forward(params, X, mean, scale):
     return jnp.argmax(logits, axis=1), probs
 
 
+def scaler_stats(X: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """The host-side standardization scaler (float64 mean, std with
+    zero-variance features pinned to 1) — ONE recipe shared by
+    :meth:`LogisticRegression.fit` and the batched sweep prep
+    (ml/sweep.py), so the solo and fused paths can never drift."""
+    mean = np.asarray(X, np.float64).mean(axis=0)
+    std = np.asarray(X, np.float64).std(axis=0)
+    return mean, np.where(std > 0, std, 1.0)
+
+
 class LogisticRegressionModel(FittedModel):
     def __init__(self, params, mean, scale, mesh: Mesh):
         self.params = params
@@ -375,9 +390,7 @@ class LogisticRegression:
         num_classes = infer_num_classes(y)
         # Standardize for conditioning (MLlib standardizes internally
         # too); the scaler is part of the fitted model.
-        mean = np.asarray(X, np.float64).mean(axis=0)
-        std = np.asarray(X, np.float64).std(axis=0)
-        scale = np.where(std > 0, std, 1.0)
+        mean, scale = scaler_stats(X)
         X_std = (np.asarray(X) - mean) / scale
         X_dev, y_dev, mask = prepare_xy(X_std, y, self.mesh)
         return self._fit_prepared(

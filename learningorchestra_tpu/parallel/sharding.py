@@ -21,36 +21,23 @@ kernels that are memory-bound anyway; masks keep the math exact.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS
-
-# Read once: per-request reads could desynchronize padded shapes (and so
-# dispatch counts) across the hosts of a multi-host mesh.
-_BUCKETS_ENABLED = os.environ.get("LO_SHAPE_BUCKETS", "1") != "0"
+from learningorchestra_tpu.utils.shapegrid import bucket_count, grid_size
 
 
 def bucket_rows(n: int) -> int:
     """Smallest quarter-octave grid value >= n: {4,5,6,7} x 2^k.
 
-    Every value is a multiple of a power of two at least n/8, so grid
-    values compose cleanly with mesh-size multiples of 2/4/8 devices.
+    THE padded-shape grid, shared with the serving MicroBatcher and the
+    job coalescer — one copy of the math (utils/shapegrid.py) so the
+    padding paths cannot drift apart.
     """
-    if n <= 8:  # grid would be sub-integer; tiny shapes compile fast
-        return n
-    power = 1 << (n.bit_length() - 1)  # largest power of two <= n
-    if n == power:
-        return n
-    for quarters in (5, 6, 7, 8):
-        candidate = power * quarters // 4
-        if candidate >= n:
-            return candidate
-    raise AssertionError("unreachable: 2*power >= n by construction")
+    return bucket_count(n)
 
 
 def padded_row_count(n: int, multiple: int) -> int:
@@ -60,7 +47,9 @@ def padded_row_count(n: int, multiple: int) -> int:
     (``multihost.shard_rows_local``) so single-host and per-host-fed
     arrays land on identical global shapes.
     """
-    target = bucket_rows(n) if _BUCKETS_ENABLED else n
+    # grid_size honors LO_SHAPE_BUCKETS (read once in utils/shapegrid —
+    # the one copy of both the math and the knob)
+    target = grid_size(n)
     return ((target + multiple - 1) // multiple) * multiple
 
 

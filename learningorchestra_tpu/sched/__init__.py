@@ -29,6 +29,12 @@ like Ray (Moritz et al., OSDI '18) put at their core:
 - :mod:`~learningorchestra_tpu.sched.cancel` — cooperative cancellation
   tokens with per-job deadlines, wired to ``DELETE /jobs/<name>`` and
   checked in the builder's phase loop.
+- :class:`~learningorchestra_tpu.sched.coalesce.Coalescer` — the
+  coalescing stage in front of the device class: shape-compatible
+  device jobs arriving within ``LO_COALESCE_WINDOW_MS`` fuse into ONE
+  ``vmap``-across-jobs dispatch (each member keeps its own record,
+  journal entry, and cancellation token; a cancelled member is masked
+  out, not a reason to abort its neighbors).
 
 ``core/jobs.py`` executes what this package admits; ``docs/scheduler.md``
 is the operator guide.
@@ -41,6 +47,7 @@ from learningorchestra_tpu.sched.cancel import (
     check_cancelled,
     current_token,
 )
+from learningorchestra_tpu.sched.coalesce import Coalescer, global_coalescer
 from learningorchestra_tpu.sched.journal import JOURNAL_COLLECTION, JobJournal
 from learningorchestra_tpu.sched.policy import (
     TransientJobError,
@@ -58,6 +65,7 @@ from learningorchestra_tpu.sched.scheduler import (
 
 __all__ = [
     "CancelToken",
+    "Coalescer",
     "DEVICE_CLASS",
     "HOST_CLASS",
     "JOURNAL_COLLECTION",
@@ -71,6 +79,7 @@ __all__ = [
     "backoff_delay",
     "check_cancelled",
     "current_token",
+    "global_coalescer",
     "is_transient",
     "recover_jobs",
 ]

@@ -22,6 +22,11 @@ env var                      default  meaning
 ``LO_SCHED_TIMEOUT_S``       0        default per-job deadline (0 = none)
 ``LO_JOB_HISTORY``           512      terminal job records kept in memory
 ``LO_JOB_TTL_S``             3600     terminal record retention seconds
+``LO_COALESCE_WINDOW_MS``    2.0      job-coalescing collection window in
+                                      milliseconds (``0`` = passthrough:
+                                      every device job dispatches alone)
+``LO_COALESCE_MAX_JOBS``     32       max member jobs fused into one
+                                      vmap-across-jobs dispatch
 ===========================  =======  =====================================
 """
 
@@ -106,3 +111,18 @@ def job_history() -> int:
 def job_ttl_s() -> float:
     """Terminal JobRecord retention before TTL eviction."""
     return _float_env("LO_JOB_TTL_S", 3600.0)
+
+
+def coalesce_window_s() -> float:
+    """The job-coalescing collection window, converted to seconds.
+    ``0`` disables coalescing entirely (passthrough: every coalescible
+    device job runs as its own dispatch)."""
+    return _float_env("LO_COALESCE_WINDOW_MS", 2.0, 0.0) / 1000.0
+
+
+def coalesce_max_jobs() -> int:
+    """Max member jobs fused into one vmap-across-jobs dispatch.
+    Strictly integral (``1.5`` must not silently truncate) and >= 1;
+    also bounds the fused dispatch's device working set — the job axis
+    multiplies every member's arrays."""
+    return _int_env("LO_COALESCE_MAX_JOBS", 32)

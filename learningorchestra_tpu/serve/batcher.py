@@ -38,6 +38,7 @@ import numpy as np
 
 from learningorchestra_tpu.sched.scheduler import QueueFullError
 from learningorchestra_tpu.telemetry import tracing as _tracing
+from learningorchestra_tpu.utils.shapegrid import grid_size, pad_axis0
 
 SERVE_CLASS = "serve"
 
@@ -287,18 +288,15 @@ class MicroBatcher:
                 model = self.registry.get(group[0].path)
                 rows = np.concatenate([request.rows for request in group])
                 total = len(rows)
-                if total < self.max_batch:
-                    # fixed dispatch shape: every small batch runs the
-                    # ONE compiled max_batch-row program (padding rows
-                    # sliced off below; zero rows are finite through
-                    # every model). Larger totals (a multi-row request
-                    # joined) ride the quarter-octave padded-shape grid
-                    # shard_rows applies, which bounds distinct
-                    # compiled shapes logarithmically.
-                    pad = np.zeros(
-                        (self.max_batch - total, rows.shape[1]), rows.dtype
-                    )
-                    rows = np.concatenate([rows, pad])
+                # fixed dispatch shape via the shared padded-shape grid
+                # (utils/shapegrid.py, the coalescer rides it too):
+                # every small batch runs the ONE compiled max_batch-row
+                # program (padding rows sliced off below; zero rows are
+                # finite through every model), and larger totals (a
+                # multi-row request joined) round up to the
+                # quarter-octave grid, which bounds distinct compiled
+                # shapes logarithmically.
+                rows = pad_axis0(rows, grid_size(total, self.max_batch))
                 _tracing.annotate(
                     rows=total,
                     bytes=int(rows.nbytes),

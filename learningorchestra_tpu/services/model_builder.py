@@ -40,7 +40,12 @@ from learningorchestra_tpu.ml.checkpoint import (
     CHECKPOINT_SUFFIX,
     checkpoint_path as _checkpoint_path,
 )
-from learningorchestra_tpu.sched import DEVICE_CLASS, QueueFullError
+from learningorchestra_tpu.ml import sweep as lo_sweep
+from learningorchestra_tpu.sched import (
+    DEVICE_CLASS,
+    QueueFullError,
+    global_coalescer,
+)
 from learningorchestra_tpu.serve import ModelNotFoundError, global_serve_plane
 from learningorchestra_tpu.serve.batcher import LATENCY_BUCKETS
 from learningorchestra_tpu.services import validators
@@ -62,6 +67,7 @@ def create_app(
     predict=None,
     jobs: "JobManager | None" = None,
     serve=None,
+    coalescer=None,
 ) -> WebApp:
     """``build``/``predict`` override how a validated request body
     becomes a build_model / predict_with_model call — the multi-host
@@ -92,6 +98,10 @@ def create_app(
     duplicate_seq = itertools.count(1)
     models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
     jobs = jobs or JobManager()
+    # the coalescing stage (sched/coalesce.py): process-wide by default
+    # so sweep jobs submitted through different apps in one process
+    # still fuse; tests inject one with pinned knobs
+    coalescer = coalescer or global_coalescer()
     register_store(store)
     # GET /jobs (+ /trace, DELETE): a build's state and span tree —
     # per-classifier train spans nesting the PhaseTimer fit/evaluate/
@@ -224,6 +234,70 @@ def create_app(
         # and the golden tests compare it whole); the job name is
         # derivable and /jobs lists it
         return {MESSAGE_RESULT: MESSAGE_CREATED_FILE}, 201
+
+    @app.route("/models/sweep", methods=("POST",))
+    def sweep_models(request):
+        """Hyperparameter sweep as ONE device job: a λ grid over ``lr``
+        or a depth grid over ``dt`` fits as one vmap-across-jobs
+        dispatch (ml/sweep.py) — per-point metrics in the response and
+        persisted as collection ``sweep_name``, the argmax checkpoint
+        published atomically so ``POST /models/<sweep_name>/predict``
+        serves the winner immediately. Concurrent sweeps (and
+        single-point "small builds") with compatible shapes coalesce
+        into one dispatch via the scheduler's coalescing stage."""
+        body = request.get_json(silent=True)
+        required = (
+            "training_filename",
+            "test_filename",
+            "preprocessor_code",
+            "classificator",
+            "grid",
+            "sweep_name",
+        )
+        if not isinstance(body, dict) or any(k not in body for k in required):
+            return {MESSAGE_RESULT: validators.MESSAGE_MISSING_FIELDS}, 406
+        try:
+            validators.filename_exists(
+                store,
+                body["training_filename"],
+                validators.MESSAGE_INVALID_TRAINING_FILENAME,
+            )
+            validators.filename_exists(
+                store,
+                body["test_filename"],
+                validators.MESSAGE_INVALID_TEST_FILENAME,
+            )
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 406
+        if not validators.safe_filename(body["sweep_name"]):
+            return {MESSAGE_RESULT: validators.MESSAGE_INVALID_FILENAME}, 406
+        max_iter = body.get("max_iter", 100)
+        if isinstance(max_iter, bool) or not isinstance(max_iter, int) or (
+            max_iter < 1
+        ):
+            return {MESSAGE_RESULT: "invalid_max_iter"}, 406
+        try:
+            lo_sweep.validate_grid(body["classificator"], body["grid"])
+        except ValueError as error:
+            return {MESSAGE_RESULT: f"invalid_grid: {error}"}, 406
+        try:
+            validators.filename_free(store, body["sweep_name"])
+        except validators.ValidationError as error:
+            return {MESSAGE_RESULT: error.args[0]}, 409
+        try:
+            result = lo_sweep.run_sweep(
+                store,
+                body,
+                jobs=jobs,
+                coalescer=coalescer,
+                models_dir=models_dir,
+                mesh=mesh,
+            )
+        except QueueFullError as error:  # device queue at its cap
+            return too_many_requests(error)
+        except DuplicateJobError as error:  # same sweep already running
+            return {MESSAGE_RESULT: str(error)}, 409
+        return {MESSAGE_RESULT: result}, 201
 
     @app.route("/models", methods=("GET",))
     def list_models(request):

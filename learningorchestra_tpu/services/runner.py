@@ -74,6 +74,10 @@ Environment:
   micro-batch collection window, the per-dispatch request cap, the
   per-request row cap (413 past it), the bounded batcher inbox (429 +
   Retry-After past it), and the per-request wait bound.
+- ``LO_COALESCE_WINDOW_MS`` / ``LO_COALESCE_MAX_JOBS`` — the job
+  coalescer (docs/scheduler.md): shape-compatible device jobs arriving
+  within the window fuse into ONE vmap-across-jobs dispatch (0 =
+  passthrough); max_jobs caps a fused batch's job axis.
 - ``LO_INGEST_SLAB_BYTES`` — CSVs past this size parse as bounded slabs
   (core/ingest.py), keeping ingest's transient working set slab-sized.
 - ``LO_AUTO_PROMOTE_S`` / ``LO_PEERS`` / ``LO_FAILOVER_TIMEOUT_S`` —
@@ -351,6 +355,17 @@ def main() -> None:
     from learningorchestra_tpu.serve import config as serve_config
 
     print(f"serving config: {serve_config.validate_all()}", flush=True)
+
+    # ...and the coalescing knobs (docs/scheduler.md): window 0 means
+    # passthrough, which an operator should see stated at boot
+    from learningorchestra_tpu.sched import config as sched_config
+
+    print(
+        "coalescing config: "
+        f"window_s={sched_config.coalesce_window_s()} "
+        f"max_jobs={sched_config.coalesce_max_jobs()}",
+        flush=True,
+    )
 
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache

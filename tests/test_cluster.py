@@ -497,6 +497,47 @@ class TestReplicationManifest:
             load({"enabled": True, "sync_repl": 2})
 
 
+class TestCoalescingManifest:
+    def test_coalescing_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["coalescing"] = {"window_ms": 5, "max_jobs": 16}
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # every machine, like sched/serving knobs
+            env = plan["env"]
+            assert env["LO_COALESCE_WINDOW_MS"] == "5"
+            assert env["LO_COALESCE_MAX_JOBS"] == "16"
+
+    def test_coalescing_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(coalescing):
+            manifest = _manifest()
+            manifest["coalescing"] = coalescing
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # window 0 = passthrough: valid; fractional window: valid
+        loaded = load({"window_ms": 0, "max_jobs": 1})
+        assert loaded["coalescing"]["window_ms"] == 0
+        assert load({"window_ms": 0.5})["coalescing"]["window_ms"] == 0.5
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"window_ms": -1})
+        with pytest.raises(SystemExit):
+            load({"max_jobs": 0})
+        with pytest.raises(SystemExit):
+            load({"max_jobs": 1.5})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"max_jobs": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"window_ms": "2"})
+
+
 class TestServingManifest:
     def test_serving_section_plumbs_env_cluster_wide(self, tmp_path):
         cluster = _load_cluster_module()

@@ -1,0 +1,657 @@
+"""Job coalescer + batched-fit sweeps (sched/coalesce.py, ml/sweep.py).
+
+The acceptance bar from the issue: a coalesced N-job batch produces
+BIT-IDENTICAL fitted params and metrics to the same N jobs run
+sequentially (window 0), including with a mid-batch cancelled member and
+a mid-batch failing member — neighbors unaffected, the failed member's
+record carrying its own error.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.sched.cancel import CancelToken, JobCancelledError
+from learningorchestra_tpu.sched.coalesce import Coalescer
+
+
+def _ok_runner(calls):
+    """A fake group runner recording each fused batch's payloads."""
+
+    def run(payloads):
+        calls.append(list(payloads))
+        outcomes = []
+        for payload in payloads:
+            if payload.get("poison"):
+                outcomes.append(
+                    ("error", ValueError(f"bad member {payload['v']}"))
+                )
+            else:
+                outcomes.append(("ok", {"v": payload["v"] * 2}))
+        return outcomes
+
+    return run
+
+
+class TestCoalescerStage:
+    """The stage's semantics with a fake runner — no jax, no scheduler:
+    run_member is called directly, so leadership is deterministic."""
+
+    def test_fused_batch_delivers_every_member(self):
+        calls = []
+        co = Coalescer(window_s=0.05, max_jobs=8)
+        runner = _ok_runner(calls)
+        members = [
+            co.register(("k",), {"v": i}, runner, name=f"m{i}")
+            for i in range(3)
+        ]
+        results = [co.run_member(m) for m in members]
+        assert [r["v"] for r in results] == [0, 2, 4]
+        assert len(calls) == 1 and len(calls[0]) == 3  # ONE fused dispatch
+        stats = co.stats()
+        assert stats["fused_dispatches"] == 1
+        assert stats["members"] == 3
+        assert stats["mean_batch_size"] == 3.0
+        assert stats["pending"] == 0
+
+    def test_window_zero_is_passthrough(self):
+        calls = []
+        co = Coalescer(window_s=0.0, max_jobs=8)
+        runner = _ok_runner(calls)
+        members = [
+            co.register(("k",), {"v": i}, runner, name=f"m{i}")
+            for i in range(3)
+        ]
+        results = [co.run_member(m) for m in members]
+        assert [r["v"] for r in results] == [0, 2, 4]
+        # no coalescing: one dispatch per job, nothing ever pending
+        assert [len(c) for c in calls] == [1, 1, 1]
+        assert co.stats()["mean_batch_size"] == 1.0
+
+    def test_max_jobs_caps_the_batch(self):
+        calls = []
+        co = Coalescer(window_s=0.02, max_jobs=2)
+        runner = _ok_runner(calls)
+        members = [
+            co.register(("k",), {"v": i}, runner, name=f"m{i}")
+            for i in range(5)
+        ]
+        for member in members:
+            co.run_member(member)
+        assert sorted(len(c) for c in calls) == [1, 2, 2]
+
+    def test_incompatible_keys_never_fuse(self):
+        calls = []
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        runner = _ok_runner(calls)
+        a = co.register(("wide",), {"v": 1}, runner, name="a")
+        b = co.register(("narrow",), {"v": 2}, runner, name="b")
+        assert co.run_member(a)["v"] == 2
+        assert co.run_member(b)["v"] == 4
+        assert [len(c) for c in calls] == [1, 1]
+
+    def test_cancelled_member_is_masked_not_its_neighbors(self):
+        calls = []
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        runner = _ok_runner(calls)
+        tokens = [CancelToken() for _ in range(3)]
+        members = [
+            co.register(("k",), {"v": i}, runner, token=tokens[i], name=f"m{i}")
+            for i in range(3)
+        ]
+        tokens[1].cancel("user gave up")
+        assert co.run_member(members[0])["v"] == 0  # leader
+        with pytest.raises(JobCancelledError):
+            co.run_member(members[1])
+        assert co.run_member(members[2])["v"] == 4
+        # the fused dispatch saw only the two live members
+        assert len(calls) == 1 and [p["v"] for p in calls[0]] == [0, 2]
+        assert co.stats()["masked"] == 1
+
+    def test_failing_member_fails_alone(self):
+        calls = []
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        runner = _ok_runner(calls)
+        members = [
+            co.register(
+                ("k",), {"v": i, "poison": i == 1}, runner, name=f"m{i}"
+            )
+            for i in range(3)
+        ]
+        assert co.run_member(members[0])["v"] == 0
+        with pytest.raises(ValueError, match="bad member 1"):
+            co.run_member(members[1])
+        assert co.run_member(members[2])["v"] == 4
+        assert len(calls) == 1 and len(calls[0]) == 3
+
+    def test_runner_wholesale_failure_fails_every_live_member(self):
+        def run(payloads):
+            raise RuntimeError("fused program died")
+
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        members = [
+            co.register(("k",), {"v": i}, run, name=f"m{i}") for i in range(3)
+        ]
+        for member in members:
+            with pytest.raises(RuntimeError, match="fused program died"):
+                co.run_member(member)
+
+    def test_outcome_count_mismatch_is_a_loud_failure(self):
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        members = [
+            co.register(("k",), {"v": i}, lambda p: [("ok", 1)], name=f"m{i}")
+            for i in range(2)
+        ]
+        for member in members:
+            with pytest.raises(RuntimeError, match="outcomes"):
+                co.run_member(member)
+
+    def test_malformed_outcome_entry_delivers_every_member(self):
+        # right COUNT, one entry not a 2-tuple: members after the bad
+        # entry must still be delivered (an undelivered member would
+        # park its follower task forever on the width-1 device lane)
+        def run(payloads):
+            return [("ok", 1), None, ("ok", 3)]
+
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        members = [
+            co.register(("k",), {"v": i}, run, name=f"m{i}") for i in range(3)
+        ]
+        assert co.run_member(members[0]) == 1  # delivered before the bug
+        for member in members[1:]:
+            with pytest.raises(TypeError):
+                co.run_member(member)
+        assert all(m.delivered for m in members)
+
+    def test_wholesale_failure_errors_are_per_member_instances(self):
+        def run(payloads):
+            raise RuntimeError("fused program died")
+
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        members = [
+            co.register(("k",), {"v": i}, run, name=f"m{i}") for i in range(3)
+        ]
+        for member in members:
+            with pytest.raises(RuntimeError, match="fused program died"):
+                co.run_member(member)
+        # fresh instance per member: concurrent re-raises must not
+        # fight over one shared __traceback__
+        assert members[0].error is not members[1].error
+        assert members[1].error is not members[2].error
+
+    def test_all_masked_batch_is_not_a_fused_dispatch(self):
+        calls = []
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        runner = _ok_runner(calls)
+        tokens = [CancelToken() for _ in range(2)]
+        members = [
+            co.register(("k",), {"v": i}, runner, token=tokens[i])
+            for i in range(2)
+        ]
+        for token in tokens:
+            token.cancel("all gone")
+        for member in members:
+            with pytest.raises(JobCancelledError):
+                co.run_member(member)
+        stats = co.stats()
+        assert not calls and stats["fused_dispatches"] == 0
+        assert stats["masked"] == 2 and stats["mean_batch_size"] is None
+
+    def test_abandoned_member_is_not_collected(self):
+        calls = []
+        co = Coalescer(window_s=0.02, max_jobs=8)
+        runner = _ok_runner(calls)
+        keep = co.register(("k",), {"v": 1}, runner, name="keep")
+        drop = co.register(("k",), {"v": 2}, runner, name="drop")
+        co.abandon(drop)
+        assert co.run_member(keep)["v"] == 2
+        assert len(calls) == 1 and [p["v"] for p in calls[0]] == [1]
+        assert co.stats()["pending"] == 0
+
+
+class TestCoalescedJobsThroughScheduler:
+    """Member jobs keep the full scheduler contract: their own
+    JobRecord lifecycle, cancellation, and per-member terminal states."""
+
+    def _manager(self):
+        from learningorchestra_tpu.core.jobs import JobManager
+        from learningorchestra_tpu.sched.scheduler import Scheduler
+
+        return JobManager(scheduler=Scheduler(queue_cap=128))
+
+    def test_concurrent_members_fuse_and_all_records_finish(self):
+        from learningorchestra_tpu.sched.scheduler import DEVICE_CLASS
+
+        calls = []
+        co = Coalescer(window_s=0.25, max_jobs=64)
+        runner = _ok_runner(calls)
+        jobs = self._manager()
+        n = 8
+        barrier = threading.Barrier(n)
+        errors = []
+
+        def client(i):
+            token = CancelToken()
+            member = co.register(
+                ("k",), {"v": i}, runner, token=token, name=f"job-{i}"
+            )
+            barrier.wait()
+            try:
+                jobs.run_sync(
+                    f"job-{i}",
+                    co.run_member,
+                    member,
+                    job_class=DEVICE_CLASS,
+                    token=token,
+                )
+            except BaseException as error:  # noqa: BLE001
+                errors.append(error)
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(n)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=30)
+        jobs.scheduler.close()
+        assert not errors
+        for i in range(n):
+            assert jobs.get(f"job-{i}").state == "finished"
+        # the width-1 device class + the window coalesced the burst:
+        # strictly fewer dispatches than jobs, so the mean batch > 1
+        assert len(calls) < n
+        assert co.stats()["mean_batch_size"] > 1
+
+    def test_per_member_terminal_states_cancel_and_fail(self):
+        from learningorchestra_tpu.sched.scheduler import DEVICE_CLASS
+
+        calls = []
+        co = Coalescer(window_s=0.25, max_jobs=64)
+        runner = _ok_runner(calls)
+        jobs = self._manager()
+        tokens = [CancelToken() for _ in range(3)]
+        tokens[1].cancel("cancelled before dispatch")
+        members = [
+            co.register(
+                ("k",),
+                {"v": i, "poison": i == 2},
+                runner,
+                token=tokens[i],
+                name=f"mix-{i}",
+            )
+            for i in range(3)
+        ]
+        outcomes = {}
+
+        def client(i):
+            try:
+                jobs.run_sync(
+                    f"mix-{i}",
+                    co.run_member,
+                    members[i],
+                    job_class=DEVICE_CLASS,
+                    token=tokens[i],
+                )
+                outcomes[i] = "ok"
+            except JobCancelledError:
+                outcomes[i] = "cancelled"
+            except ValueError:
+                outcomes[i] = "failed"
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(3)
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=30)
+        jobs.scheduler.close()
+        assert outcomes == {0: "ok", 1: "cancelled", 2: "failed"}
+        assert jobs.get("mix-0").state == "finished"
+        assert jobs.get("mix-1").state == "cancelled"
+        record = jobs.get("mix-2").as_dict()
+        assert record["state"] == "failed"
+        assert "bad member 2" in record["error"]  # its OWN error
+        # the cancelled member never reached a fused dispatch
+        assert all(
+            payload["v"] != 1 for call in calls for payload in call
+        )
+
+
+def _member_data(seed: int, rows: int = 100, features: int = 6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(rows, features))
+    y = (X[:, 0] + 0.3 * rng.normal(size=rows) > 0).astype(np.int64)
+    return X, y
+
+
+class TestBatchedFitBitIdentity:
+    """THE correctness bar: fused == sequential, bit for bit."""
+
+    @pytest.fixture()
+    def mesh(self):
+        from learningorchestra_tpu.ml.base import resolve_mesh
+
+        return resolve_mesh(None)
+
+    def _solo_results(self, specs, mesh, max_iter=15):
+        """Each member through the passthrough (window 0) path — the
+        sequential baseline the issue names."""
+        from learningorchestra_tpu.ml import sweep as lo_sweep
+
+        solo = Coalescer(window_s=0.0, max_jobs=8)
+        runner = lo_sweep.group_runner(mesh)
+        results = []
+        for i, (X, y, grid) in enumerate(specs):
+            key, payload = lo_sweep.prepare_member(
+                "lr", X, y, X, y, grid, mesh=mesh, max_iter=max_iter
+            )
+            member = solo.register(key, payload, runner, name=f"solo-{i}")
+            results.append(solo.run_member(member))
+        return results
+
+    def test_coalesced_batch_is_bit_identical_to_sequential(self, mesh):
+        from learningorchestra_tpu.ml import sweep as lo_sweep
+
+        specs = [
+            (*_member_data(i), [{"reg_param": l2}])
+            for i, l2 in enumerate((0.0, 0.1, 0.01, 0.5, 0.0))
+        ]
+        fused = Coalescer(window_s=0.05, max_jobs=8)
+        runner = lo_sweep.group_runner(mesh)
+        members = []
+        for i, (X, y, grid) in enumerate(specs):
+            key, payload = lo_sweep.prepare_member(
+                "lr", X, y, X, y, grid, mesh=mesh, max_iter=15
+            )
+            members.append(fused.register(key, payload, runner, name=f"f{i}"))
+        fused_results = [fused.run_member(m) for m in members]
+        assert fused.stats()["fused_dispatches"] == 1  # ONE dispatch
+        for fused_result, solo_result in zip(
+            fused_results, self._solo_results(specs, mesh)
+        ):
+            f_point, s_point = fused_result["points"][0], solo_result["points"][0]
+            # metrics: bit-identical floats, not just close
+            assert f_point["accuracy"] == s_point["accuracy"]
+            assert f_point["weighted_f1"] == s_point["weighted_f1"]
+            # fitted params: bit-identical arrays
+            np.testing.assert_array_equal(
+                fused_result["params"][0]["w"], solo_result["params"][0]["w"]
+            )
+            np.testing.assert_array_equal(
+                fused_result["params"][0]["b"], solo_result["params"][0]["b"]
+            )
+
+    def test_mid_batch_cancel_and_failure_leave_neighbors_bit_identical(
+        self, mesh
+    ):
+        from learningorchestra_tpu.ml import sweep as lo_sweep
+
+        specs = [
+            (*_member_data(10 + i), [{"reg_param": 0.05 * i}])
+            for i in range(5)
+        ]
+        # member 3 is poisoned: NaN features must fail IT alone
+        specs[3][0][7, 2] = np.nan
+        fused = Coalescer(window_s=0.05, max_jobs=8)
+        runner = lo_sweep.group_runner(mesh)
+        tokens = [CancelToken() for _ in range(5)]
+        members = []
+        for i, (X, y, grid) in enumerate(specs):
+            key, payload = lo_sweep.prepare_member(
+                "lr", X, y, X, y, grid, mesh=mesh, max_iter=15
+            )
+            members.append(
+                fused.register(
+                    key, payload, runner, token=tokens[i], name=f"f{i}"
+                )
+            )
+        tokens[1].cancel("mid-batch cancel")  # member 1 masked out
+        fused_results = {}
+        for i, member in enumerate(members):
+            if i == 1:
+                with pytest.raises(JobCancelledError):
+                    fused.run_member(member)
+            elif i == 3:
+                with pytest.raises(ValueError, match="non-finite"):
+                    fused.run_member(member)
+            else:
+                fused_results[i] = fused.run_member(member)
+        assert fused.stats()["masked"] == 1
+        survivors = [0, 2, 4]
+        solo = self._solo_results([specs[i] for i in survivors], mesh)
+        for solo_result, i in zip(solo, survivors):
+            np.testing.assert_array_equal(
+                fused_results[i]["params"][0]["w"],
+                solo_result["params"][0]["w"],
+            )
+            assert (
+                fused_results[i]["points"][0]["accuracy"]
+                == solo_result["points"][0]["accuracy"]
+            )
+
+    def test_dt_fused_matches_passthrough_bitwise(self, mesh):
+        from learningorchestra_tpu.ml import sweep as lo_sweep
+
+        grid = [{"max_depth": 2}, {"max_depth": 3}]
+        specs = [(*_member_data(20 + i), grid) for i in range(3)]
+        runner = lo_sweep.group_runner(mesh)
+
+        def run(window_s):
+            co = Coalescer(window_s=window_s, max_jobs=8)
+            members = []
+            for i, (X, y, g) in enumerate(specs):
+                key, payload = lo_sweep.prepare_member(
+                    "dt", X, y, X, y, g, mesh=mesh
+                )
+                members.append(co.register(key, payload, runner, name=f"d{i}"))
+            return [co.run_member(m) for m in members], co
+
+        fused_results, fused_co = run(0.05)
+        solo_results, _ = run(0.0)
+        assert fused_co.stats()["fused_dispatches"] == 1
+        for fused_result, solo_result in zip(fused_results, solo_results):
+            for f_params, s_params in zip(
+                fused_result["params"], solo_result["params"]
+            ):
+                np.testing.assert_array_equal(
+                    f_params["features_heap"], s_params["features_heap"]
+                )
+                np.testing.assert_array_equal(
+                    f_params["leaf_probs"], s_params["leaf_probs"]
+                )
+            for f_point, s_point in zip(
+                fused_result["points"], solo_result["points"]
+            ):
+                assert f_point["accuracy"] == s_point["accuracy"]
+
+    def test_lr_sweep_tracks_the_solo_estimator(self, mesh):
+        """Anchor the batched program to the product estimator: same
+        data, λ=0, full iteration budget → near-identical params (the
+        batched path skips the plateau exit, so compare with tol=0)."""
+        from learningorchestra_tpu.ml import sweep as lo_sweep
+        from learningorchestra_tpu.ml.logistic import LogisticRegression
+
+        X, y = _member_data(42)
+        key, payload = lo_sweep.prepare_member(
+            "lr", X, y, X, y, [{"reg_param": 0.0}], mesh=mesh, max_iter=25
+        )
+        co = Coalescer(window_s=0.0, max_jobs=8)
+        member = co.register(key, payload, lo_sweep.group_runner(mesh))
+        result = co.run_member(member)
+        solo = LogisticRegression(max_iter=25, tol=0.0, mesh=mesh).fit(X, y)
+        np.testing.assert_allclose(
+            result["params"][0]["w"],
+            np.asarray(solo.params["w"]),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+        accuracy, _ = solo.evaluate(X, y)
+        assert abs(result["points"][0]["accuracy"] - accuracy) < 1e-6
+
+
+PREPROCESSOR = (
+    "from pyspark.ml.feature import VectorAssembler\n"
+    "assembler = VectorAssembler(inputCols=['a', 'b'], outputCol='features')\n"
+    "features_training = assembler.transform(training_df)\n"
+    "features_testing = assembler.transform(testing_df)\n"
+    "features_evaluation = None\n"
+)
+
+
+class TestSweepRoute:
+    @pytest.fixture()
+    def numeric_store(self, store):
+        from learningorchestra_tpu.core.table import write_columns
+
+        write_columns(
+            store,
+            "numbers",
+            {
+                "a": [float(i % 7) for i in range(240)],
+                "b": [float((i * 3) % 5) for i in range(240)],
+                "label": [float(i % 2) for i in range(240)],
+            },
+            {"filename": "numbers", "finished": True,
+             "fields": ["a", "b", "label"]},
+        )
+        return store
+
+    def _app(self, store, tmp_path, **kwargs):
+        from learningorchestra_tpu.services import model_builder
+
+        kwargs.setdefault("coalescer", Coalescer(window_s=0.0, max_jobs=8))
+        return model_builder.create_app(
+            store, models_dir=str(tmp_path), **kwargs
+        )
+
+    def _body(self, **overrides):
+        body = {
+            "training_filename": "numbers",
+            "test_filename": "numbers",
+            "preprocessor_code": PREPROCESSOR,
+            "classificator": "lr",
+            "grid": [{"reg_param": 0.0}, {"reg_param": 0.5}],
+            "sweep_name": "numbers_sweep",
+            "max_iter": 10,
+        }
+        body.update(overrides)
+        return body
+
+    def test_lr_sweep_metrics_checkpoint_and_serving_pickup(
+        self, numeric_store, tmp_path
+    ):
+        import json
+        import os
+
+        client = self._app(numeric_store, tmp_path).test_client()
+        response = client.post("/models/sweep", json=self._body())
+        assert response.status_code == 201, response.get_data()
+        result = json.loads(response.get_data())["result"]
+        assert result["model"] == "numbers_sweep"
+        assert len(result["points"]) == 2
+        for point in result["points"]:
+            assert 0.0 <= point["accuracy"] <= 1.0
+            assert 0.0 <= point["weighted_f1"] <= 1.0
+        assert result["best"] in (0, 1)
+        # the argmax checkpoint is a real published artifact...
+        assert os.path.isfile(os.path.join(str(tmp_path), "numbers_sweep.model"))
+        listing = json.loads(client.get("/models").get_data())["result"]
+        assert "numbers_sweep" in listing
+        # ...the serving registry picks it up like any other build
+        predict = client.post(
+            "/models/numbers_sweep/predict", json={"rows": [[1.0, 2.0]]}
+        )
+        assert predict.status_code == 200, predict.get_data()
+        # per-point metrics persisted as the sweep's collection
+        document = numeric_store.find_one("numbers_sweep", {})
+        assert document["finished"] is True
+        assert document["best"] == result["best"]
+        assert len(document["points"]) == 2
+        # the record + trace surface every job gets
+        jobs = json.loads(client.get("/jobs").get_data())["result"]
+        assert any(
+            job["name"] == "sweep:numbers_sweep"
+            and job["state"] == "finished"
+            for job in jobs
+        )
+
+    def test_dt_depth_sweep(self, numeric_store, tmp_path):
+        import json
+
+        client = self._app(numeric_store, tmp_path).test_client()
+        body = self._body(
+            classificator="dt",
+            grid=[{"max_depth": 2}, {"max_depth": 3}],
+            sweep_name="numbers_dt_sweep",
+        )
+        body.pop("max_iter")
+        response = client.post("/models/sweep", json=body)
+        assert response.status_code == 201, response.get_data()
+        result = json.loads(response.get_data())["result"]
+        assert [point["max_depth"] for point in result["points"]] == [2, 3]
+
+    def test_validation_surface(self, numeric_store, tmp_path):
+        client = self._app(numeric_store, tmp_path).test_client()
+        cases = [
+            ({"training_filename": "ghost"}, 406),
+            ({"classificator": "svm"}, 406),
+            ({"grid": []}, 406),
+            ({"grid": [{"reg_param": -1.0}]}, 406),
+            ({"grid": [{"reg_param": True}]}, 406),
+            ({"grid": [{"max_depth": 3}]}, 406),  # wrong key for lr
+            ({"sweep_name": "../escape"}, 406),
+            ({"max_iter": 0}, 406),
+            ({"max_iter": "ten"}, 406),
+        ]
+        for overrides, expected in cases:
+            response = client.post("/models/sweep", json=self._body(**overrides))
+            assert response.status_code == expected, (overrides, response.get_data())
+        missing = self._body()
+        del missing["grid"]
+        assert client.post("/models/sweep", json=missing).status_code == 406
+
+    def test_sweep_name_collision_is_409(self, numeric_store, tmp_path):
+        client = self._app(numeric_store, tmp_path).test_client()
+        assert (
+            client.post("/models/sweep", json=self._body()).status_code == 201
+        )
+        assert (
+            client.post("/models/sweep", json=self._body()).status_code == 409
+        )
+
+    def test_sdk_sweep_over_http(self, numeric_store, tmp_path):
+        import learningorchestra_tpu.client as lo_client
+        from learningorchestra_tpu.utils.web import ServerThread
+
+        app = self._app(numeric_store, tmp_path)
+        server = ServerThread(app, "127.0.0.1", 0).start()
+        saved_port = lo_client.Model.MODEL_BUILDER_PORT
+        try:
+            lo_client.Model.MODEL_BUILDER_PORT = str(server.port)
+            lo_client.Context("127.0.0.1")
+            sdk = lo_client.Model()
+            # sweep polls the database API for dataset readiness first
+            # (create_model parity); no database_api runs in this test
+            sdk._wait_finished = lambda *args, **kwargs: None
+            result = sdk.sweep(
+                "numbers",
+                "numbers",
+                PREPROCESSOR,
+                "lr",
+                [{"reg_param": 0.0}, {"reg_param": 0.3}],
+                "sdk_sweep",
+                max_iter=10,
+                pretty_response=False,
+            )
+            assert result["result"]["model"] == "sdk_sweep"
+            assert len(result["result"]["points"]) == 2
+            # the reference-parity PyPI shim exposes the same surface
+            from learning_orchestra_client import Model as ShimModel
+
+            assert ShimModel.sweep is lo_client.Model.sweep
+        finally:
+            lo_client.Model.MODEL_BUILDER_PORT = saved_port
+            server.stop()

@@ -42,6 +42,10 @@ def test_pip_install_provides_reference_client_surface(tmp_path):
         "assert DatabaseApi.DATABASE_API_PORT == '5000'\n"
         "assert Model.MODEL_BUILDER_PORT == '5002'\n"
         "assert callable(Model.predict) and callable(Model.list_models)\n"
+        "assert callable(Model.sweep)\n"
+        # the coalescing stage + batched-fit entry points ship installed
+        "import learningorchestra_tpu.sched.coalesce as co\n"
+        "assert callable(co.global_coalescer)\n"
         # the flight recorder ships with the telemetry package (stdlib
         # imports only, so the bare install can load it)
         "import learningorchestra_tpu.telemetry.profile as prof\n"

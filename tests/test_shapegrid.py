@@ -1,0 +1,104 @@
+"""The shared padded-shape grid (utils/shapegrid.py).
+
+One copy of the quarter-octave math serves the sharding row pad, the
+serving MicroBatcher's fixed dispatch shape, and the coalescer's job
+axis — these tests pin the grid's contract so none of the three can
+drift.
+"""
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.utils.shapegrid import (
+    bucket_count,
+    grid_size,
+    pad_axis0,
+    padded_indices,
+)
+
+
+class TestBucketCount:
+    def test_small_counts_pass_through(self):
+        for n in range(1, 9):
+            assert bucket_count(n) == n
+
+    def test_powers_of_two_pass_through(self):
+        for k in range(3, 20):
+            assert bucket_count(1 << k) == 1 << k
+
+    def test_grid_values_are_quarter_octave(self):
+        # every bucket is {4,5,6,7} x 2^k for some k
+        for n in list(range(9, 4097)) + [10**6, 10**7 + 3]:
+            bucket = bucket_count(n)
+            assert bucket >= n
+            mantissa = bucket
+            while mantissa % 2 == 0:
+                mantissa //= 2
+            assert mantissa in (1, 3, 5, 7), (n, bucket)
+
+    def test_monotone_and_bounded_waste(self):
+        previous = 0
+        for n in range(1, 3000):
+            bucket = bucket_count(n)
+            assert bucket >= previous
+            previous = bucket
+            if n > 8:
+                assert bucket <= n * 1.25  # worst-case padding waste
+
+    def test_idempotent(self):
+        for n in range(1, 3000):
+            assert bucket_count(bucket_count(n)) == bucket_count(n)
+
+    def test_sharding_delegates_here(self):
+        # the data-plane row pad is THIS grid, not a private copy
+        from learningorchestra_tpu.parallel.sharding import bucket_rows
+
+        for n in (1, 7, 9, 100, 1000, 12345):
+            assert bucket_rows(n) == bucket_count(n)
+
+
+class TestGridSize:
+    def test_floor_pins_small_counts(self):
+        # the MicroBatcher contract: all small traffic shares ONE shape
+        for n in range(1, 65):
+            assert grid_size(n, floor=64) == 64
+
+    def test_above_floor_rides_the_grid(self):
+        assert grid_size(65, floor=64) == bucket_count(65)
+        assert grid_size(1000, floor=64) == bucket_count(1000)
+
+    def test_no_floor_is_plain_bucketing(self):
+        for n in (1, 5, 9, 100):
+            assert grid_size(n) == bucket_count(n)
+
+    def test_shape_buckets_knob_disables_above_floor_only(self, monkeypatch):
+        # LO_SHAPE_BUCKETS=0 (read once at import; patch the flag):
+        # above-floor counts get minimal padding, the fixed floor stays
+        from learningorchestra_tpu.utils import shapegrid
+
+        monkeypatch.setattr(shapegrid, "_BUCKETS_ENABLED", False)
+        assert shapegrid.grid_size(65, floor=64) == 65
+        assert shapegrid.grid_size(1000, floor=64) == 1000
+        assert shapegrid.grid_size(50, floor=64) == 64
+
+
+class TestPadHelpers:
+    def test_pad_axis0_zero_fills(self):
+        array = np.arange(6, dtype=np.float32).reshape(3, 2)
+        padded = pad_axis0(array, 5)
+        assert padded.shape == (5, 2)
+        np.testing.assert_array_equal(padded[:3], array)
+        assert not padded[3:].any()
+
+    def test_pad_axis0_noop_at_target(self):
+        array = np.ones((4, 2), np.float32)
+        assert pad_axis0(array, 4) is array
+        assert pad_axis0(array, 2) is array
+
+    def test_padded_indices_replicate_slot_zero(self):
+        assert padded_indices(3, 5) == [0, 1, 2, 0, 0]
+        assert padded_indices(4, 4) == [0, 1, 2, 3]
+
+    def test_padded_indices_need_a_real_entry(self):
+        with pytest.raises(ValueError):
+            padded_indices(0, 4)
