@@ -465,6 +465,8 @@ def bench_product(X, y) -> dict:
             for key in ("hits", "misses", "evictions", "invalidations")
         } | {"bytes": after["bytes"], "entries": after["entries"]}
 
+    from learningorchestra_tpu.telemetry import profile as _profile_flows
+
     before_cold = global_devcache().stats()
     start = time.perf_counter()
     results = run()
@@ -484,11 +486,22 @@ def bench_product(X, y) -> dict:
     from learningorchestra_tpu.telemetry import tracing as _tracing
 
     before_warm = global_devcache().stats()
+    # Byte-flow deltas around the WARM build (wire bytes, decode
+    # seconds, H2D bytes — the boundary bill the zero-copy wire PR
+    # drives down): recorded per round and direction-gated by
+    # --compare, so a copy creeping back into the read path fails the
+    # round by name instead of hiding inside warm_s.
+    flows_before = _profile_flows.flow_totals()
     warm_trace = _tracing.Trace(name="bench_product_warm")
     start = time.perf_counter()
     with _tracing.activate(warm_trace):
         results = run()
     warm_s = time.perf_counter() - start  # what a steady-state request costs
+    flows_after = _profile_flows.flow_totals()
+    warm_flows = {
+        key: round(flows_after[key] - flows_before[key], 6)
+        for key in ("wire_read_bytes", "shm_bytes", "decode_s", "h2d_bytes")
+    }
     devcache_warm = devcache_delta(before_warm)
     warm_summary = _profile.trace_summary(warm_trace)
     warm_phases = {
@@ -509,12 +522,97 @@ def bench_product(X, y) -> dict:
         "warm_speedup_vs_cold": round(cold_s / warm_s, 2),
         "devcache_cold": devcache_cold,
         "devcache_warm": devcache_warm,
+        "warm_flows": warm_flows,
         "warm_attribution_s": warm_phases,
         "per_classifier_phases_s": phases,
         "accuracy": {
             r["classificator"]: float(r["accuracy"]) for r in results
         },
     }
+
+
+def bench_wire() -> dict:
+    """Wire-transport section: the SAME dataset read through the binary
+    store wire as v1 frames (per-column decode copies), v2 frames
+    (aligned zero-copy views, one allocation per chunk), and the
+    shared-memory ring (no HTTP body at all) — MB/s plus each
+    transport's decode-seconds bill, the numbers the zero-copy data
+    plane moves (docs/dataplane.md)."""
+    from learningorchestra_tpu.core.store import InMemoryStore
+    from learningorchestra_tpu.core.store_service import (
+        RemoteStore,
+        create_store_app,
+    )
+    from learningorchestra_tpu.telemetry import profile as _profile
+    from learningorchestra_tpu.utils.web import ServerThread
+
+    rows = int(os.environ.get("LO_BENCH_WIRE_ROWS", "400000"))
+    rng = np.random.default_rng(13)
+    store = InMemoryStore()
+    server = ServerThread(
+        create_store_app(store, shm=True), "127.0.0.1", 0
+    ).start()
+    url = f"http://127.0.0.1:{server.port}"
+    try:
+        # ingest server-side directly: this section measures the READ
+        # transports, not ingest
+        columns = {f"f{i}": rng.random(rows) for i in range(8)}
+        columns["tag"] = np.array(
+            [f"row{i % 997}" for i in range(rows)], dtype=object
+        )
+        store.create_collection("bench_wire")
+        store.insert_columns(
+            "bench_wire",
+            {name: values.tolist() for name, values in columns.items()},
+            start_id=1,
+        )
+        payload_mb = rows * 8 * 8 / 1e6  # the float payload alone
+
+        clients = {
+            "v1": RemoteStore(url, wire_v2=False, shm_bytes=0),
+            "v2": RemoteStore(url, shm_bytes=0),
+            "shm": RemoteStore(url, shm_bytes=256_000_000),
+        }
+        out: dict = {"rows": rows, "payload_mb": round(payload_mb, 1)}
+        baseline = None
+        for name, client in clients.items():
+            read = lambda c=client: c.read_column_arrays("bench_wire")  # noqa: E731
+            read()  # warm connections + negotiate
+            before = _profile.flow_totals()
+            elapsed = _best_of(read, repeats=2)
+            after = _profile.flow_totals()
+            entry = {
+                "read_s": round(elapsed, 4),
+                "mb_per_s": round(payload_mb / elapsed, 1),
+                "decode_s": round(
+                    (after["decode_s"] - before["decode_s"]) / 2, 5
+                ),
+                "wire_read_bytes": int(
+                    (after["wire_read_bytes"] - before["wire_read_bytes"])
+                    / 2
+                ),
+                "shm_bytes": int(
+                    (after["shm_bytes"] - before["shm_bytes"]) / 2
+                ),
+            }
+            out[name] = entry
+            if name == "v1":
+                baseline = entry
+            client.close()
+        if baseline:
+            for name in ("v2", "shm"):
+                out[f"{name}_read_speedup"] = round(
+                    baseline["read_s"] / out[name]["read_s"], 2
+                )
+                decode = out[name]["decode_s"]
+                out[f"{name}_decode_speedup"] = (
+                    round(baseline["decode_s"] / decode, 1)
+                    if decode > 0
+                    else None
+                )
+        return out
+    finally:
+        server.stop()
 
 
 def bench_serve() -> dict:
@@ -956,8 +1054,13 @@ def bench_mfu() -> dict:
 _HIGHER_IS_BETTER = (
     "rows_per_sec", "per_s", "predictions_per_s", "speedup", "mfu",
     "gb_per_s", "vs_baseline", "accuracy", "trustworthiness",
-    "mean_batch_size",
+    "mean_batch_size", "ratio",
 )
+# byte-flow totals that gate DOWN (checked before the generic "bytes"
+# fact token below eats them): wire and H2D traffic for the same
+# workload growing past threshold means a copy/transfer crept back
+# into the data plane (the zero-copy wire PR's regression gate)
+_LOWER_PRIORITY = ("wire_read_bytes", "wire_write_bytes", "h2d_bytes")
 _LOWER_IS_BETTER = ("_s", "_ms", "seconds", "p50_ms", "p99_ms")
 # numeric facts that are not performance (never gated, still diffed)
 _UNGATED = (
@@ -1000,6 +1103,9 @@ def _metric_direction(path: str):
         for token in _HIGHER_IS_BETTER:
             if token in segment:
                 return "up"
+        for token in _LOWER_PRIORITY:
+            if token in segment:
+                return "down"
         for token in _UNGATED:
             if (
                 segment == token
@@ -1153,6 +1259,16 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
     # eat the budget, the first casualty must be the diagnostic, not
     # the product-path or embeddings measurements.
     section("product_path", lambda: bench_product(X, y))
+    product = extra.get("product_path")
+    if isinstance(product, dict) and "product_rows_per_sec_warm" in product:
+        # the kernel↔product gap, as ONE gated number: how much of the
+        # hardware's fit throughput the warm REST-path build delivers
+        # (ROADMAP's "close the host-boundary gap" metric; gates UP)
+        product["warm_vs_kernel_ratio"] = round(
+            product["product_rows_per_sec_warm"] / kernels["rows_per_sec"],
+            4,
+        )
+    section("wire", bench_wire)  # transport head-to-head (v1/v2/shm)
     section("serve", bench_serve)  # the online predict lane's latency
     section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch
     section("embeddings", bench_embeddings)
@@ -1189,6 +1305,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
             "product_rows_per_sec_warm"
         )
         summary["warm_speedup_vs_cold"] = product.get("warm_speedup_vs_cold")
+        summary["warm_vs_kernel_ratio"] = product.get("warm_vs_kernel_ratio")
         warm_cache = product.get("devcache_warm")
         if isinstance(warm_cache, dict):
             summary["devcache_warm"] = {

@@ -51,6 +51,10 @@ Manifest (JSON)::
         "store_compress": 0,       #   LO_STORE_COMPRESS (1 = zlib wire)
         "write_overlap": 1         #   LO_WRITE_OVERLAP (0 = sync writes)
       },
+      "wire": {                    # optional zero-copy wire knobs
+        "shm_bytes": 0,            #   LO_SHM_BYTES (ring size; 0 = off)
+        "dtype_policy": "f32"      #   LO_DTYPE_POLICY (f32 | bf16)
+      },
       "coalescing": {              # optional job-coalescing knobs
         "window_ms": 2,            #   LO_COALESCE_WINDOW_MS (>= 0;
         "max_jobs": 32             #   0 = passthrough) / LO_COALESCE_
@@ -160,6 +164,24 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("dataplane.devcache_bytes must be >= 0")
         elif value not in (0, 1):
             raise SystemExit(f"dataplane.{key} must be 0 or 1")
+    wire = manifest.setdefault("wire", {})
+    for key in wire:
+        if key not in _WIRE_KNOBS:
+            raise SystemExit(
+                f"unknown wire knob {key!r} (have: "
+                f"{', '.join(sorted(_WIRE_KNOBS))})"
+            )
+        value = wire[key]
+        if key == "shm_bytes":
+            # same bool-is-int trap as the sched knobs: JSON true would
+            # stringify to "True" and fail every preflight downstream
+            if not isinstance(value, int) or isinstance(value, bool):
+                raise SystemExit("wire.shm_bytes must be an integer")
+            if value < 0:  # 0 = shared-memory transport off, valid
+                raise SystemExit("wire.shm_bytes must be >= 0")
+        elif key == "dtype_policy":
+            if not isinstance(value, str) or value not in ("f32", "bf16"):
+                raise SystemExit("wire.dtype_policy must be f32 or bf16")
     coalescing = manifest.setdefault("coalescing", {})
     for key in coalescing:
         if key not in _COALESCING_KNOBS:
@@ -280,6 +302,16 @@ _DATAPLANE_KNOBS = {
     "write_overlap": "LO_WRITE_OVERLAP",
 }
 
+# manifest wire.<knob> -> the env var every machine receives
+# (docs/dataplane.md). Cluster-wide NON-NEGOTIABLY for dtype_policy:
+# it is part of every devcache key and of SPMD dispatch shapes, so a
+# per-host skew would desynchronize multi-host dispatch. shm_bytes
+# rides along for symmetric co-located topologies.
+_WIRE_KNOBS = {
+    "shm_bytes": "LO_SHM_BYTES",
+    "dtype_policy": "LO_DTYPE_POLICY",
+}
+
 # manifest coalescing.<knob> -> the env var every machine receives
 # (docs/scheduler.md). Cluster-wide: coalescing keys include the mesh
 # signature, and a per-host window skew would make "the same flood"
@@ -363,6 +395,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _DATAPLANE_KNOBS.items():
         if knob in manifest.get("dataplane", {}):
             shared[env_var] = str(manifest["dataplane"][knob])
+    for knob, env_var in _WIRE_KNOBS.items():
+        if knob in manifest.get("wire", {}):
+            shared[env_var] = str(manifest["wire"][knob])
     for knob, env_var in _COALESCING_KNOBS.items():
         if knob in manifest.get("coalescing", {}):
             shared[env_var] = str(manifest["coalescing"][knob])

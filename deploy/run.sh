@@ -37,6 +37,14 @@
 #                         narrow links; default 0)
 #   LO_WRITE_OVERLAP      0 = synchronous prediction write-back
 #                         (default 1: writes overlap the next fit)
+#   LO_SHM_BYTES          shared-memory ring size for co-located
+#                         store reads (bytes, 1e9 notation ok;
+#                         default 0 = disabled — frames ride the
+#                         HTTP body)
+#   LO_DTYPE_POLICY       feature-matrix dtype: f32 (default) or bf16
+#                         (halves H2D + HBM; must match on every host)
+#   LO_WIRE_V2            0 = escape hatch back to v1 wire frames
+#                         (default 1: aligned zero-copy frames)
 #
 # Serving knobs (docs/serving.md has the full table):
 #   LO_SERVE_BYTES           device-byte budget for pinned models
@@ -90,6 +98,13 @@ config.host_width(); config.device_width(); config.queue_cap()
 config.coalesce_window_s(); config.coalesce_max_jobs()
 from learningorchestra_tpu.core import devcache
 devcache.capacity_bytes()
+# zero-copy wire knobs: shm ring size >= 0 (1e9 notation ok, 0 =
+# disabled), dtype policy f32|bf16 (part of every devcache key and of
+# SPMD dispatch shapes — must be identical on every host)
+from learningorchestra_tpu.core import shmring
+shmring.shm_bytes()
+from learningorchestra_tpu.utils import dtypepolicy
+dtypepolicy.validate_env()
 # serving knobs: reject non-numeric / out-of-range before bring-up
 # (window >= 0, bytes >= 0 with 0 = host-only fallback)
 from learningorchestra_tpu.serve import config as serve_config
@@ -98,7 +113,7 @@ serve_config.validate_all()
 from learningorchestra_tpu.telemetry import profile as lo_profile
 lo_profile.validate_env()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
-             "LO_STORE_SYNC_REPL"):
+             "LO_STORE_SYNC_REPL", "LO_WIRE_V2"):
     value = os.environ.get(knob, "").strip()
     if value and value not in ("0", "1"):
         raise SystemExit(f"{knob} must be 0 or 1, got {value!r}")

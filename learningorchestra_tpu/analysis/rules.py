@@ -832,6 +832,69 @@ def check_lo104(tree: ast.Module) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------
+# LO106 — host copies on core/ encode/decode hot paths
+# --------------------------------------------------------------------
+
+# The rule is PATH-gated: only modules under core/ (the store's cell
+# engine, wire framing, and service — every dataset byte funnels
+# through them) are hot enough that one stray copy re-taxes the whole
+# data plane. The zero-copy wire rework (core/wire.py v2) removed these
+# copies; this rule keeps them from silently returning.
+
+
+def _is_frombuffer_chain(node: ast.AST) -> bool:
+    """True when ``node`` is an ``np.frombuffer(...)`` call, possibly
+    chained through view-shaping methods (``.reshape``/``.view``/
+    ``.astype`` receivers) — ``np.frombuffer(b).reshape(-1, w).copy()``
+    is the same double pass as the direct spelling."""
+    while isinstance(node, ast.Call):
+        name = call_name(node)
+        if name and _last_part(name) == "frombuffer":
+            return True
+        if not isinstance(node.func, ast.Attribute):
+            return False
+        node = node.func.value
+    return False
+
+
+def _lo106_in_scope(path: str) -> bool:
+    normalized = "/" + path.replace("\\", "/")
+    return "/core/" in normalized
+
+
+def check_lo106(tree: ast.Module, path: str) -> Iterator[Finding]:
+    if not _lo106_in_scope(path):
+        return
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+        ):
+            continue
+        reason = None
+        if node.func.attr == "copy" and _is_frombuffer_chain(
+            node.func.value
+        ):
+            reason = (
+                "np.frombuffer(...).copy() copies the freshly-wrapped "
+                "wire buffer — decode into a view (the v2 zero-copy "
+                "path, core/wire.py) or justify the ownership copy "
+                "with `# lo: allow[LO106]`"
+            )
+        elif node.func.attr == "tobytes":
+            reason = (
+                ".tobytes() copies a live buffer on a core/ "
+                "encode/decode path — hand the numpy view over "
+                "(memoryview/buffer protocol) instead, or justify "
+                "with `# lo: allow[LO106]`"
+            )
+        if reason and node.lineno not in seen:
+            seen.add(node.lineno)
+            yield Finding("", node.lineno, "LO106", reason)
+
+
+# --------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------
 
@@ -850,16 +913,25 @@ RULES = {
     ),
     "LO103": (check_lo103, "host sync inside jit-compiled code"),
     "LO104": (check_lo104, "float64 dtype in device code"),
+    "LO106": (
+        check_lo106,
+        "host copy (frombuffer().copy() / .tobytes()) on a core/ "
+        "encode/decode hot path",
+    ),
     **CONCURRENCY_RULES,
 }
+
+# rules whose check takes (tree, path): the LO2xx family (lock registry
+# ranks are keyed by module path) and LO106 (scope-gated to core/)
+_PATH_RULES = set(CONCURRENCY_RULES) | {"LO106"}
 
 
 def run_rules(tree: ast.Module, path: str = "<string>") -> Iterator[Finding]:
     """Every rule over one module. ``path`` feeds the LO2xx rules'
     declared lock registry (cross-module lock ranks are keyed by module
-    path); the LO1xx checks ignore it."""
+    path) and LO106's core/ scope gate; the LO1xx checks ignore it."""
     for rule_id, (check, _description) in RULES.items():
-        if rule_id in CONCURRENCY_RULES:
+        if rule_id in _PATH_RULES:
             yield from check(tree, path)
         else:
             yield from check(tree)

@@ -48,7 +48,26 @@ from typing import Any, Iterable, Optional
 
 import numpy as np
 
-__all__ = ["Column", "MISSING", "merge_kind"]
+__all__ = ["Column", "FrameOwner", "MISSING", "merge_kind"]
+
+
+class FrameOwner:
+    """Ownership token for a zero-copy decoded wire frame: every column
+    view of one frame references the SAME aligned backing buffer
+    through this token, so pinning any decoded column (the device
+    cache's host tier) keeps exactly one allocation alive — and
+    ``nbytes`` tells the pinning cache what that costs. The buffer is
+    read-only; a column view marked ``_shared`` copies before any
+    in-place mutation, so a caller can never corrupt a pinned frame."""
+
+    __slots__ = ("base",)
+
+    def __init__(self, base):
+        self.base = base
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.base.nbytes)
 
 
 class _Missing:
@@ -139,21 +158,34 @@ def _group_key(value):
     return (isinstance(value, bool), value)
 
 
-def _pack(mask: Optional[np.ndarray], size: int) -> Optional[bytes]:
+def _pack(mask: Optional[np.ndarray], size: int) -> Optional[np.ndarray]:
+    """Packed-bit buffer for the wire — handed over as the packbits
+    array itself (a fresh allocation already), never a second
+    ``tobytes`` copy (LO106)."""
     if mask is None:
         return None
-    return np.packbits(mask[:size]).tobytes()
+    return np.packbits(mask[:size])
 
 
-def _unpack(raw: Optional[bytes], size: int) -> Optional[np.ndarray]:
+def _unpack(raw, size: int) -> Optional[np.ndarray]:
     if raw is None:
         return None
-    return np.unpackbits(
-        np.frombuffer(raw, dtype=np.uint8), count=size
-    ).astype(bool)
+    bits = (
+        raw
+        if isinstance(raw, np.ndarray)
+        else np.frombuffer(raw, dtype=np.uint8)
+    )
+    if size > 8 * len(bits):
+        # np.unpackbits with count past the buffer reads OUT OF BOUNDS
+        # silently (observed: garbage bytes, no error) — a short mask
+        # buffer must raise like any other truncated wire payload
+        raise ValueError("packed mask shorter than the row count")
+    return np.unpackbits(bits, count=size).astype(bool)
 
 
-def _b64(raw: Optional[bytes]) -> Optional[str]:
+def _b64(raw) -> Optional[str]:
+    """Base64 of any bytes-like buffer (bytes or a contiguous numpy
+    view — wire_parts hands over views, never tobytes copies)."""
     return None if raw is None else base64.b64encode(raw).decode("ascii")
 
 
@@ -177,6 +209,9 @@ def _encode_strings(values: list) -> tuple[np.ndarray, np.ndarray]:
     offsets = np.empty(n + 1, dtype=np.int64)
     offsets[0] = 0
     np.cumsum(lengths, out=offsets[1:])
+    # the ingest path builds an OWNED, appendable byte buffer from the
+    # transient encode — this copy IS the allocation, not a redundancy
+    # lo: allow[LO106]
     return np.frombuffer(encoded, dtype=np.uint8).copy(), offsets
 
 
@@ -201,11 +236,15 @@ class Column:
         "edits",
         "_shared",
         "spill",
+        "owner",
     )
 
     def __init__(self, kind: str = EMPTY):
         self.kind = kind
         self.size = 0
+        # Zero-copy wire decode: the FrameOwner whose aligned buffer
+        # this column's data/offsets view into (None = owned buffers).
+        self.owner: Optional["FrameOwner"] = None
         # Out-of-core state: {"dir", "prefix"} once the payload lives in
         # disk-backed mappings (spill_to); None = all-RAM buffers.
         self.spill: Optional[dict] = None
@@ -331,6 +370,8 @@ class Column:
                     none = nan
             if none is not None and none.any():
                 column.none = none.astype(bool).copy()
+            if not column.data.flags.writeable:
+                column._shared = True  # read-only source: copy-on-write
             return column
         if array.dtype == np.bool_:
             column = cls(BOOL)
@@ -355,6 +396,8 @@ class Column:
             if column.kind == F8:
                 column.data = column.data.copy()
                 column.data[column.none] = np.nan
+        if not column.data.flags.writeable:
+            column._shared = True  # read-only source: copy-on-write
         return column
 
     @classmethod
@@ -440,6 +483,7 @@ class Column:
         clone.miss = self.miss
         clone.intm = self.intm
         clone.edits = dict(self.edits) if self.edits else None
+        clone.owner = self.owner
         # The clone READS the shared mapping but must never take the
         # append-into-file path — only one column may own the file tail.
         clone.spill = None
@@ -462,6 +506,7 @@ class Column:
         if self.offsets is not None:
             self.offsets = np.array(self.offsets)
         self.spill = None  # buffers are anonymous RAM again
+        self.owner = None  # owned copies no longer pin a wire frame
         for slot in ("none", "miss", "intm"):
             mask = getattr(self, slot)
             if mask is not None:
@@ -519,6 +564,15 @@ class Column:
     def append_column(self, other: "Column") -> "Column":
         """Append ``other``'s cells; returns the (possibly re-kinded)
         column — callers must re-assign. The store's one append path."""
+        if other.size == 0 and merge_kind(self.kind, other.kind) in (
+            self.kind,
+            EMPTY,
+        ):
+            # nothing to add and no kind change: return unchanged. This
+            # also keeps zero-length slice-assignments away from
+            # read-only zero-copy wire views (the paged read loop
+            # appends the terminal empty chunk through here).
+            return self
         if other.kind == EMPTY and self.kind not in (EMPTY, NUM):
             other = other._as_kind(self.kind, width=self._vec_width())
         merged = merge_kind(self.kind, other.kind)
@@ -553,14 +607,21 @@ class Column:
             other = other._materialized()
             my_bytes = int(self.offsets[self.size])
             their_bytes = int(other.offsets[other.size])
-            if len(self.data) < my_bytes + their_bytes:
-                capacity = max(my_bytes + their_bytes, 2 * len(self.data), 4096)
-                grown = np.empty(capacity, dtype=np.uint8)
-                grown[:my_bytes] = self.data[:my_bytes]
-                self.data = grown
-            self.data[my_bytes : my_bytes + their_bytes] = other.data[
-                :their_bytes
-            ]
+            if their_bytes:
+                # guarded: a chunk of all-empty/null strings carries
+                # rows but ZERO bytes — the no-growth path would then
+                # slice-assign zero length into a possibly read-only
+                # zero-copy wire view, which numpy rejects
+                if len(self.data) < my_bytes + their_bytes:
+                    capacity = max(
+                        my_bytes + their_bytes, 2 * len(self.data), 4096
+                    )
+                    grown = np.empty(capacity, dtype=np.uint8)
+                    grown[:my_bytes] = self.data[:my_bytes]
+                    self.data = grown
+                self.data[my_bytes : my_bytes + their_bytes] = other.data[
+                    :their_bytes
+                ]
             if len(self.offsets) < self.size + other.size + 1:
                 capacity = max(
                     self.size + other.size + 1, 2 * len(self.offsets)
@@ -1054,15 +1115,30 @@ class Column:
             mask = getattr(self, slot)
             if mask is not None:
                 setattr(out, slot, mask[start:stop])
+        out.owner = self.owner
         out._shared = True
         self._shared = True
         return out
 
     def to_float64(self, start: int = 0, stop: Optional[int] = None) -> np.ndarray:
         """float64 view (nulls/pads → NaN) — the design-matrix hand-off.
-        Raises TypeError for non-numeric kinds."""
+        Raises TypeError for non-numeric kinds. Mask-free f8/num
+        columns hand back a READ-ONLY view of the buffer itself (zero
+        copy on the store→matrix path; the copy only happens when NaN
+        masking must write) and flip the column copy-on-write — so a
+        later column mutation can never rewrite an already-assembled
+        matrix, and a matrix writer can never corrupt the store (the
+        isolation the old always-copy gave, kept without the copy)."""
         stop = self.size if stop is None else min(stop, self.size)
+        absent = self._absent_mask()
         if self.kind in (F8, NUM):
+            if absent is None:
+                view = self.data[start:stop].astype(np.float64, copy=False)
+                if view.flags.writeable:
+                    view = view[:]  # fresh view object; base untouched
+                    view.flags.writeable = False
+                self._shared = True  # next in-place write copies first
+                return view
             out = self.data[start:stop].astype(np.float64, copy=True)
         elif self.kind == I8:
             out = self.data[start:stop].astype(np.float64)
@@ -1070,7 +1146,6 @@ class Column:
             return np.full(stop - start, np.nan)
         else:
             raise TypeError(f"{self.kind} column is not numeric")
-        absent = self._absent_mask()
         if absent is not None:
             out[absent[start:stop]] = np.nan
         return out
@@ -1171,28 +1246,32 @@ class Column:
         return out
 
     # --- serialization --------------------------------------------------------
-    def wire_parts(self) -> tuple[dict, list[bytes]]:
+    def wire_parts(self) -> tuple[dict, list]:
         """(meta, buffers) for the binary HTTP frame (core/wire.py).
         Buffer order: data, offsets, none, miss, intm — present iff the
-        corresponding meta flag says so."""
+        corresponding meta flag says so. Buffers are handed over as
+        numpy views of the live payload (``ascontiguousarray`` on an
+        already-contiguous slice is free) — the frame assembly writes
+        them into the output exactly once, with no intermediate
+        ``tobytes`` copies (LO106)."""
         source = self._materialized()
         n = source.size
         meta: dict = {"kind": source.kind, "n": n}
-        buffers: list[bytes] = []
+        buffers: list = []
         if source.kind == OBJ:
             meta["values"] = source.tolist(pad_as_none=True)
         elif source.kind == STR:
             nbytes = int(source.offsets[n])
-            buffers.append(source.data[:nbytes].tobytes())
-            buffers.append(np.ascontiguousarray(source.offsets[: n + 1]).tobytes())
+            buffers.append(np.ascontiguousarray(source.data[:nbytes]))
+            buffers.append(np.ascontiguousarray(source.offsets[: n + 1]))
             meta["data"] = True
             meta["offsets"] = True
         elif source.kind == VEC:
             meta["w"] = source.data.shape[1]
-            buffers.append(np.ascontiguousarray(source.data[:n]).tobytes())
+            buffers.append(np.ascontiguousarray(source.data[:n]))
             meta["data"] = True
         elif source.kind != EMPTY:
-            buffers.append(np.ascontiguousarray(source.data[:n]).tobytes())
+            buffers.append(np.ascontiguousarray(source.data[:n]))
             meta["data"] = True
         for slot in ("none", "miss", "intm"):
             mask = getattr(source, slot)
@@ -1206,44 +1285,83 @@ class Column:
         return meta, buffers
 
     @classmethod
-    def from_wire_parts(cls, meta: dict, buffers: list[bytes]) -> "Column":
+    def from_wire_parts(
+        cls,
+        meta: dict,
+        buffers: list,
+        copy: bool = True,
+        owner: Optional["FrameOwner"] = None,
+    ) -> "Column":
+        """Rebuild a column from its wire buffers.
+
+        ``copy=True`` (v1 frames, WAL base64 records) produces a column
+        that OWNS its buffers. ``copy=False`` (aligned v2 frames,
+        core/wire.py) produces read-only numpy *views* over the frame's
+        one backing buffer — zero per-column copies; ``owner`` is the
+        frame's :class:`FrameOwner` token, recorded on the column so a
+        pinning consumer (the device cache) holds exactly one
+        allocation. Zero-copy columns are marked ``_shared``: any
+        in-place mutation copies first (copy-on-write), so a caller
+        writing through a view can never corrupt the pinned frame."""
         kind = meta["kind"]
         n = meta["n"]
         column = cls(kind)
         column.size = n
         index = 0
 
-        def take() -> bytes:
+        def take():
             nonlocal index
             raw = buffers[index]
             index += 1
             return raw
 
+        def typed(raw, dtype):
+            if not copy:
+                # raw is an aligned uint8 view (core/wire.py): a dtype
+                # reinterpretation of it is the zero-copy hand-off
+                return raw.view(dtype)
+            # v1/WAL decode contract: the column must own its buffers
+            # (the source bytes are transient) — this copy is that
+            # ownership, not a removable redundancy
+            # lo: allow[LO106]
+            return np.frombuffer(raw, dtype=dtype).copy()
+
         if kind == OBJ:
             column.data = list(meta["values"])
         elif kind == STR:
-            column.data = np.frombuffer(take(), dtype=np.uint8).copy()
-            column.offsets = np.frombuffer(take(), dtype=np.int64).copy()
+            column.data = typed(take(), np.uint8)
+            column.offsets = typed(take(), np.int64)
         elif kind == VEC:
             width = int(meta["w"])
-            raw = np.frombuffer(take(), dtype=np.float64).copy()
-            column.data = (
-                raw.reshape(-1, width)
-                if width
-                else np.empty((n, 0), dtype=np.float64)
-            )
+            # ALWAYS consume the data buffer — wire_parts emits one for
+            # width-0 vec columns too (empty), and skipping it would
+            # shift every following mask buffer onto the wrong slot
+            raw = take()
+            if width:
+                # reshape the flat VIEW first, then (only under v1)
+                # copy once — never allocate flat and reshape after
+                flat = (
+                    raw.view(np.float64)
+                    if not copy
+                    else np.frombuffer(raw, dtype=np.float64)
+                )
+                shaped = flat.reshape(-1, width)
+                column.data = shaped if not copy else shaped.copy()
+            else:
+                column.data = np.empty((n, 0), dtype=np.float64)
         elif kind == EMPTY:
             column.data = np.zeros(n, dtype=np.uint8)
         else:
-            column.data = np.frombuffer(
-                take(), dtype=_DTYPES[kind]
-            ).copy()
+            column.data = typed(take(), _DTYPES[kind])
         for slot in ("none", "miss", "intm"):
             if meta.get(slot):
                 setattr(column, slot, _unpack(take(), n))
         if kind == NUM and column.intm is None:
             # defensive: a NUM column always carries its int mask
             column.intm = np.zeros(n, dtype=bool)
+        if not copy:
+            column.owner = owner
+            column._shared = True  # copy-on-write before any mutation
         return column
 
     def to_json_record(self) -> dict:

@@ -56,6 +56,8 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from learningorchestra_tpu.utils.dtypepolicy import dtype_policy
+
 # Content-addressed entries live under this pseudo-collection: their key
 # embeds a digest of the bytes, so they cannot go stale and are never
 # rev-invalidated — only LRU-evicted.
@@ -420,14 +422,17 @@ def dataset_embedding_inputs(store, collection: str, mesh=None, cache=None):
         # shard_rows funnel, parallel/sharding.py) and accumulates onto
         # this span as h2d_bytes
         with span(
-            "h2d:dataset", collection=collection, rows=len(X), dtype="f32"
+            "h2d:dataset",
+            collection=collection,
+            rows=len(X),
+            dtype=dtype_policy(),
         ):
             return encoded, vocabularies, shard_matrix(X, mesh)
 
     return cache.get_or_load(
         store,
         collection,
-        ("embed_inputs", mesh_signature(mesh), "f32"),
+        ("embed_inputs", mesh_signature(mesh), dtype_policy()),
         load,
         lambda value: _table_nbytes(value[0]) + _device_matrix_nbytes(value[2]),
     )
@@ -455,11 +460,13 @@ def content_device_matrix(X: np.ndarray, mesh):
     from learningorchestra_tpu.telemetry import span
 
     cache = global_devcache()
-    subkey = ("devmat", _content_digest(X), mesh_signature(mesh), "f32")
+    subkey = (
+        "devmat", _content_digest(X), mesh_signature(mesh), dtype_policy()
+    )
     cached = cache.get(CONTENT, CONTENT, subkey, rev=0)
     if cached is not None:
         return cached
-    with span("h2d:matrix", rows=len(X), dtype="f32"):
+    with span("h2d:matrix", rows=len(X), dtype=dtype_policy()):
         dm = shard_matrix(X, mesh)
     return cache.put(
         CONTENT, CONTENT, subkey, 0, dm, _device_matrix_nbytes(dm)

@@ -105,6 +105,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
+import numpy as np
 import requests
 
 from learningorchestra_tpu.core.arbiter import grant_vote
@@ -118,12 +119,15 @@ from learningorchestra_tpu.core.store import (
 from learningorchestra_tpu.telemetry import profile as _profile
 from learningorchestra_tpu.telemetry import tracing as _tracing
 from learningorchestra_tpu.testing import faults
+from learningorchestra_tpu.core import shmring
 from learningorchestra_tpu.core.wire import (
     ACCEPT_HEADER,
     COMPRESS_MIN_BYTES,
     CONTENT_TYPE as BIN_CONTENT_TYPE,
     ENCODING_HEADER,
     WIRE_COMPRESSION,
+    WIRE_V2,
+    accept_tokens,
     compress_frame,
     decode_body,
     decode_frame,
@@ -157,14 +161,25 @@ def _values_match(stored, sent) -> bool:
         return False
 
 
-def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebApp:
+def create_store_app(
+    store: DocumentStore,
+    role: Optional[dict] = None,
+    shm: Optional[bool] = None,
+) -> WebApp:
     """``role`` (mutable, shared with the caller) carries the HA state:
     ``{"writable": bool, "poller": ReplicationClient | None}``. A
     follower serves every read with ``writable: False`` and answers
     mutations with 503 until ``POST /promote`` flips it — the failover
     the reference delegates to Mongo's replica-set election
-    (docker-compose.yml:27-91)."""
+    (docker-compose.yml:27-91). ``shm`` overrides the env-derived
+    shared-memory-transport enablement (tests, bench)."""
     app = WebApp("store")
+    # Shared-memory ring transport (core/shmring.py): enabled when this
+    # server's LO_SHM_BYTES > 0 — the runner/stack exports one value to
+    # the whole co-located process tree, so client and server agree.
+    # Read at app creation (not import) so tests can toggle the env.
+    shm_enabled = shmring.shm_bytes() > 0 if shm is None else bool(shm)
+    rings = shmring.ServerRings()
     # the store SERVER scrapes its own occupancy (collections, WAL
     # bytes, spill bytes) at GET /metrics; remote-store CLIENTS don't
     from learningorchestra_tpu.telemetry import register_store
@@ -264,7 +279,12 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
             # arbiters
             "voted_term": role.get("voted_term", 0),
             "boot": role.get("boot", ""),  # equal-term fence tiebreak
-            "columns_wire": "bin1",
+            # wire capability advertisement: bin2 = this server decodes
+            # AND (when asked via X-Lo-Columns-Accept: v2) emits the
+            # aligned zero-copy frame layout; clients probe it once to
+            # decide their upload encoding (reads negotiate per request)
+            "columns_wire": "bin2",
+            "shm": shm_enabled,
         }
         poller = role.get("poller")
         if poller is not None:
@@ -491,14 +511,41 @@ def create_store_app(store: DocumentStore, role: Optional[dict] = None) -> WebAp
                 limit=body.get("limit"),
             )
             rev = -1
-        frame = encode_frame(columns, extra={"rev": rev})
+        accepts = accept_tokens(request.headers.get(ACCEPT_HEADER))
+        # frame-version negotiation: emit the aligned zero-copy layout
+        # only to a client that advertised it — old clients keep
+        # receiving v1 frames, and decode_frame dispatches on the magic
+        # either way
+        version = 2 if WIRE_V2 in accepts else 1
+        frame = encode_frame(columns, extra={"rev": rev}, version=version)
         if faults.torn("store.wire.read_chunk"):
             frame = frame[: max(1, len(frame) // 2)]  # truncated mid-buffer
+        segment = request.headers.get(shmring.SEGMENT_HEADER)
+        if shm_enabled and segment:
+            # co-located fast path: the frame goes into the client's
+            # shared-memory ring and the response carries only the slot
+            # coordinates — no HTTP body, no compression. An attach
+            # failure (not co-located, segment gone) or an oversized
+            # frame falls through to the body transparently.
+            try:
+                seg_bytes = int(request.headers.get(shmring.BYTES_HEADER, 0))
+            except ValueError:
+                seg_bytes = 0
+            placed = rings.place(segment, seg_bytes, frame)
+            if placed is not None:
+                offset, length, generation = placed
+                return Response(
+                    b"{}",
+                    mimetype="application/json",
+                    status=200,
+                    headers={
+                        shmring.OFFSET_HEADER: str(offset),
+                        shmring.LENGTH_HEADER: str(length),
+                        shmring.GENERATION_HEADER: str(generation),
+                    },
+                )
         headers = {}
-        if (
-            WIRE_COMPRESSION in request.headers.get(ACCEPT_HEADER, "")
-            and len(frame) >= COMPRESS_MIN_BYTES
-        ):
+        if WIRE_COMPRESSION in accepts and len(frame) >= COMPRESS_MIN_BYTES:
             frame = compress_frame(frame)
             headers[ENCODING_HEADER] = WIRE_COMPRESSION
         return Response(
@@ -652,6 +699,8 @@ class RemoteStore(DocumentStore):
         wire_rows: Optional[int] = None,
         failover_timeout: Optional[float] = None,
         compress: Optional[bool] = None,
+        wire_v2: Optional[bool] = None,
+        shm_bytes: Optional[int] = None,
     ):
         # A comma-separated ``base_url`` names the replica pair; the
         # client talks to one server at a time and re-points itself at
@@ -693,6 +742,30 @@ class RemoteStore(DocumentStore):
         self.chunk_retries = max(
             0, int(os.environ.get("LO_CHUNK_RETRIES", "2"))
         )
+        # LO_WIRE_V2=0 is the escape hatch back to v1 frames (the
+        # default advertises v2 on reads and, once /health confirms a
+        # bin2 server, uploads v2 too — old servers just keep talking
+        # v1, negotiated per request through X-Lo-Columns-Accept).
+        self.wire_v2 = (
+            os.environ.get("LO_WIRE_V2", "1") != "0"
+            if wire_v2 is None
+            else wire_v2
+        )
+        # upload frame version, decided lazily by one /health probe
+        # (None = not probed yet); reads negotiate per request instead
+        self._upload_version_cache: Optional[int] = None
+        # Shared-memory ring (core/shmring.py): LO_SHM_BYTES > 0 makes
+        # this client create a segment and advertise it on binary
+        # reads; a server that can attach it answers with ring slots
+        # instead of HTTP bodies. Lazy — the segment exists only once a
+        # binary read happens; creation failure disables the ring for
+        # this client (body transport is always correct).
+        self.shm_bytes = (
+            shmring.shm_bytes() if shm_bytes is None else int(shm_bytes)
+        )
+        self._shm_ring = None
+        self._shm_failed = False
+        self._shm_lock = threading.Lock()
         self._local = threading.local()
         # collection → monotonic time of the last AMBIGUOUS write
         # failure (connection death / timeout / 5xx mid-request) this
@@ -926,7 +999,14 @@ class RemoteStore(DocumentStore):
                     if server_errors > server_error_budget:
                         raise last_error
                     continue
-                self.base_url = url
+                if url != self.base_url:
+                    self.base_url = url
+                    # the peer we failed over to may speak a different
+                    # frame version (rolling upgrade: a bin2 primary
+                    # dying onto a v1-only follower) — re-probe before
+                    # the next upload instead of shipping frames the
+                    # new server cannot decode
+                    self._upload_version_cache = None
                 return self._finish(
                     response, ambiguous, landed_ok, collection, verify
                 )
@@ -991,6 +1071,24 @@ class RemoteStore(DocumentStore):
             verify=verify,
         ).json()
 
+    def _upload_version(self) -> int:
+        """Frame version for uploads: 2 once one lazy ``/health`` probe
+        confirms a bin2-capable server, else 1. Reads need no probe
+        (they negotiate per request via the Accept header); uploads do,
+        because the client speaks first. A failed probe means v1 — the
+        version every server understands. Benignly racy: two threads
+        probing concurrently cache the same answer."""
+        if not self.wire_v2:
+            return 1
+        version = self._upload_version_cache
+        if version is None:
+            health = probe_health(self.base_url)
+            version = (
+                2 if health and health.get("columns_wire") == "bin2" else 1
+            )
+            self._upload_version_cache = version
+        return version
+
     def _documents_landed(
         self, collection: str, documents: list[dict]
     ) -> bool:
@@ -1009,16 +1107,59 @@ class RemoteStore(DocumentStore):
         except Exception:
             return False  # verification must never mask the original 409
 
-    def _fetch_frame_bytes(self, path: str, body: dict) -> bytes:
-        """POST JSON, receive raw frame bytes (wire compression undone).
+    def _ring(self):
+        """The client's shared-memory ring, created on first use; None
+        when disabled or unavailable (no /dev/shm, creation failed)."""
+        if self.shm_bytes <= 0:
+            return None
+        with self._shm_lock:
+            if self._shm_ring is None and not self._shm_failed:
+                try:
+                    self._shm_ring = shmring.ClientRing(self.shm_bytes)
+                except Exception:  # noqa: BLE001 — body transport works
+                    self._shm_failed = True
+            return self._shm_ring
+
+    def _accept_value(self) -> str:
+        tokens = []
+        if self.wire_v2:
+            tokens.append("v2")
+        if self.compress:
+            tokens.append(WIRE_COMPRESSION)
+        return ",".join(tokens)
+
+    def close(self) -> None:
+        """Release the client's shared-memory segment (also runs at
+        garbage collection via the ring's finalizer)."""
+        with self._shm_lock:
+            if self._shm_ring is not None:
+                self._shm_ring.close()
+                self._shm_ring = None
+                self._shm_failed = True
+
+    def shm_stats(self) -> Optional[dict]:
+        """Ring traffic counters, or None before/without a ring."""
+        with self._shm_lock:
+            ring = self._shm_ring
+        return None if ring is None else ring.stats()
+
+    def _fetch_frame_bytes(self, path: str, body: dict, allow_shm: bool = True):
+        """POST JSON, receive one frame — as raw bytes (wire compression
+        undone) over the HTTP body, or as an aligned numpy buffer copied
+        out of the shared-memory ring when the server placed it there.
 
         Kept separate from the decode so the double-buffered read loop
         can run the network fetch on a helper thread while the main
         thread decodes the previous chunk."""
         data = json.dumps(body)
         headers = {"Content-Type": "application/json"}
-        if self.compress:
-            headers[ACCEPT_HEADER] = WIRE_COMPRESSION
+        accept = self._accept_value()
+        if accept:
+            headers[ACCEPT_HEADER] = accept
+        ring = self._ring() if allow_shm else None
+        if ring is not None:
+            headers[shmring.SEGMENT_HEADER] = ring.name
+            headers[shmring.BYTES_HEADER] = str(ring.nbytes)
         response = self._send(
             lambda base: self._session.post(
                 f"{base}{path}",
@@ -1027,6 +1168,20 @@ class RemoteStore(DocumentStore):
                 timeout=self.timeout,
             )
         )
+        slot_offset = response.headers.get(shmring.OFFSET_HEADER)
+        if ring is not None and slot_offset is not None:
+            try:
+                return ring.read(
+                    int(slot_offset),
+                    int(response.headers.get(shmring.LENGTH_HEADER, -1)),
+                    int(response.headers.get(shmring.GENERATION_HEADER, -1)),
+                )
+            except shmring.ShmTornError:
+                # the server lapped the ring while we copied (deep
+                # prefetch against a small segment): re-fetch THIS
+                # chunk over the plain body — correctness never
+                # depends on the ring
+                return self._fetch_frame_bytes(path, body, allow_shm=False)
         return decode_body(
             response.content, response.headers.get(ENCODING_HEADER)
         )
@@ -1157,7 +1312,7 @@ class RemoteStore(DocumentStore):
                 )
             self._post_frame(
                 f"/c/{collection}/insert_columns_bin",
-                encode_frame(chunk, extra=extra),
+                encode_frame(chunk, extra=extra, version=self._upload_version()),
                 # chunks at an explicit start_id: a duplicate rejection
                 # on the post-failover replay means the chunk landed
                 landed_ok=start_id is not None,
@@ -1209,6 +1364,7 @@ class RemoteStore(DocumentStore):
                 encode_frame(
                     {field: column.slice(offset, stop)},
                     extra={"field": field, "start_id": start_id + offset},
+                    version=self._upload_version(),
                 ),
                 collection=collection,
             )
@@ -1451,7 +1607,13 @@ class RemoteStore(DocumentStore):
                         next_start,
                         next_limit,
                     )
-                _profile.account_wire("read", collection, len(raw))
+                if isinstance(raw, np.ndarray):
+                    # the frame rode the shared-memory ring: these
+                    # bytes never crossed the HTTP body, so they count
+                    # as shm traffic, not wire traffic
+                    _profile.account_shm(collection, len(raw))
+                else:
+                    _profile.account_wire("read", collection, len(raw))
                 decode_started = time.perf_counter()
                 columns, extra = self._decode_chunk(
                     collection, fields, chunk_start, chunk_limit, raw

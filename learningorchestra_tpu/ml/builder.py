@@ -34,6 +34,7 @@ from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
 from learningorchestra_tpu.sched import cancel as _cancel
 from learningorchestra_tpu.sched.cancel import check_cancelled
 from learningorchestra_tpu.telemetry import tracing as _tracing
+from learningorchestra_tpu.utils.dtypepolicy import dtype_policy
 from learningorchestra_tpu.utils.profiling import PhaseTimer, trace
 
 FEATURES_COL = "features"
@@ -232,7 +233,9 @@ def train_one(
     y_train = features_training.label_vector(LABEL_COL)
 
     classifier = make_classifier(classificator_name, mesh=mesh)
-    with timer.phase("fit", rows=len(X_train), dtype="f32"):
+    # dtype rides the phase attrs so a trace says which LO_DTYPE_POLICY
+    # (f32 vs bf16 feature matrices) produced these numbers
+    with timer.phase("fit", rows=len(X_train), dtype=dtype_policy()):
         # the rendezvous guard serializes the whole dispatch+drain on a
         # single-process CPU backend (see _CPU_RENDEZVOUS_LOCK); a
         # no-op on real accelerators and under multi-process SPMD

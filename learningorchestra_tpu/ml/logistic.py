@@ -14,7 +14,7 @@ annotations (no hand-written NCCL/allreduce as in torch-style ports).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -137,8 +137,7 @@ def _two_loop(state):
     return jax.tree.map(jnp.negative, r)
 
 
-@partial(jax.jit, static_argnames=("iters",))
-def _fit_segment(params, opt_state, X, y, mask, iters: int, l2):
+def _fit_segment_impl(params, opt_state, X, y, mask, iters: int, l2):
     """``iters`` L-BFGS iterations as ONE program, optimizer state in
     and out — chained by :func:`_fit` so arbitrarily long optimizations
     never exceed a single execution's wall-clock budget while the
@@ -243,6 +242,36 @@ def _fit_segment(params, opt_state, X, y, mask, iters: int, l2):
     return params, opt_state, losses
 
 
+# The shared, undonated program: what ml/sweep.py vmaps (donation inside
+# an outer trace would be inert) and what CPU backends run.
+_fit_segment = partial(jax.jit, static_argnames=("iters",))(_fit_segment_impl)
+
+
+@lru_cache(maxsize=None)
+def _donated_fit_segment():
+    return jax.jit(
+        _fit_segment_impl,
+        static_argnames=("iters",),
+        donate_argnums=(0, 1),
+    )
+
+
+def _fit_segment_runner():
+    """The segment program :func:`_fit` chains: (params, opt_state) are
+    DONATED — each segment's outputs rebind exactly those arguments, so
+    XLA reuses their HBM across L-BFGS segments instead of holding two
+    generations of curvature ring buffers live per boundary (the
+    ``donate_argnums`` discipline, SNIPPETS.md [3]). X/y/mask are NOT
+    donated: every segment re-reads them. CPU backends don't implement
+    donation — they fall back to the shared undonated program, read as
+    the MODULE attribute at call time (tests script `_fit_segment`;
+    resolving lazily also means importing this module never initializes
+    the device backend)."""
+    if jax.default_backend() == "cpu":
+        return _fit_segment
+    return _donated_fit_segment()
+
+
 # Per-program budget in row*iterations: ~18 iterations at 10M rows
 # (~1.6 s/iteration on one tunneled v5e) keeps a segment under ~30 s.
 _LR_ROW_ITERS_BUDGET = 180e6
@@ -306,8 +335,9 @@ def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
     # three consecutive sub-tol improvements is a plateau, one is noise.
     history: list[float] = []
     window = _LR_STOP_DELTAS + 1
+    segment = _fit_segment_runner()
     for _ in range(max_iter // iters):
-        params, opt_state, segment_losses = _fit_segment(
+        params, opt_state, segment_losses = segment(
             params, opt_state, X, y, mask, iters, l2
         )
         losses.append(segment_losses)

@@ -30,7 +30,7 @@ flow:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -508,8 +508,9 @@ def _gbt_init(y, weights):
     return f0, jnp.full(y.shape[0], f0, jnp.float32)
 
 
-@partial(jax.jit, static_argnames=("max_depth", "max_bins", "rounds"))
-def _gbt_rounds(bins, y, weights, margins, max_depth, max_bins, rounds, step):
+def _gbt_rounds_impl(
+    bins, y, weights, margins, max_depth, max_bins, rounds, step
+):
     """``rounds`` boosting rounds as one program, margins in and out —
     chained by :func:`_gbt_fit` (see base.segment_steps)."""
     y_f = y.astype(jnp.float32)
@@ -528,6 +529,36 @@ def _gbt_rounds(bins, y, weights, margins, max_depth, max_bins, rounds, step):
         one_round, margins, length=rounds
     )
     return margins, features_heap, bins_heap, leaf_values
+
+
+_gbt_rounds = partial(
+    jax.jit, static_argnames=("max_depth", "max_bins", "rounds")
+)(_gbt_rounds_impl)
+
+
+@lru_cache(maxsize=None)
+def _donated_gbt_rounds():
+    return jax.jit(
+        _gbt_rounds_impl,
+        static_argnames=("max_depth", "max_bins", "rounds"),
+        donate_argnums=(3,),
+    )
+
+
+def _gbt_rounds_runner():
+    """The segment program :func:`_gbt_fit` chains: the margin vector
+    (argument 3) is DONATED — each segment's output margins rebind it,
+    so XLA reuses that (rows,)-sized HBM buffer across boosting
+    segments instead of holding two generations per boundary
+    (``donate_argnums``, SNIPPETS.md [3]). bins/y/weights are re-read
+    every segment and stay undonated. CPU backends don't implement
+    donation and use the shared undonated program, read as the MODULE
+    attribute at call time (so tests can script it; resolving lazily
+    also means importing this module never initializes the device
+    backend)."""
+    if jax.default_backend() == "cpu":
+        return _gbt_rounds
+    return _donated_gbt_rounds()
 
 
 # Per-program budget in row*rounds: one boosting round builds a whole
@@ -552,8 +583,9 @@ def _gbt_fit(bins, y, weights, max_depth, max_bins, rounds, step):
         rounds, bins.shape[0], _GB_ROW_ROUNDS_BUDGET, bins.shape[1]
     )
     heaps = []
+    rounds_chunk = _gbt_rounds_runner()
     for _ in range(rounds // chunk):
-        margins, features_heap, bins_heap, leaf_values = _gbt_rounds(
+        margins, features_heap, bins_heap, leaf_values = rounds_chunk(
             bins, y, weights, margins, max_depth, max_bins, chunk, step
         )
         heaps.append((features_heap, bins_heap, leaf_values))

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from learningorchestra_tpu.parallel.mesh import DATA_AXIS
+from learningorchestra_tpu.utils.dtypepolicy import dtype_policy
 from learningorchestra_tpu.utils.shapegrid import bucket_count, grid_size
 
 
@@ -74,6 +75,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def policy_dtype(dtype):
+    """The dtype a float buffer actually ships in under
+    ``LO_DTYPE_POLICY``: ``bf16`` maps float requests to bfloat16 —
+    halving the H2D transfer and the HBM-resident matrix — while int,
+    bool, and mask buffers are never touched. Identity under ``f32``."""
+    if dtype is None:
+        return None
+    if dtype_policy() == "bf16" and np.issubdtype(
+        np.dtype(dtype), np.floating
+    ):
+        return jnp.bfloat16
+    return dtype
+
+
 def shard_rows(
     array: np.ndarray, mesh: Mesh, dtype=None
 ) -> tuple[jax.Array, jax.Array]:
@@ -81,12 +96,15 @@ def shard_rows(
 
     Returns ``(device_array, device_mask)`` where the boolean mask marks
     real (non-padding) rows; both are sharded identically so masked
-    reductions stay local until the final psum.
+    reductions stay local until the final psum. Float ``dtype`` requests
+    flow through :func:`policy_dtype`, so ``LO_DTYPE_POLICY=bf16``
+    halves every feature-matrix transfer at THE H2D funnel without any
+    caller opting in per site.
     """
     n_shards = mesh.shape[DATA_AXIS]
     padded, mask = pad_rows(np.asarray(array), n_shards)
     if dtype is not None:
-        padded = padded.astype(dtype)
+        padded = padded.astype(policy_dtype(dtype))
     sharding = row_sharded(mesh)
     # Flight-recorder byte accounting at THE H2D funnel (every matrix/
     # label transfer in the product path comes through here): counts

@@ -367,6 +367,20 @@ def main() -> None:
         flush=True,
     )
 
+    # ...and the zero-copy wire knobs (docs/dataplane.md): shm_bytes 0
+    # means frames ride the HTTP body — an operator expecting the ring
+    # should see that stated at boot, and a typo'd LO_DTYPE_POLICY
+    # must refuse bring-up, never silently fit at the wrong precision
+    from learningorchestra_tpu.core import shmring
+    from learningorchestra_tpu.utils.dtypepolicy import dtype_policy
+
+    print(
+        f"wire config: shm_bytes={shmring.shm_bytes()} "
+        f"dtype_policy={dtype_policy()} "
+        f"v2={os.environ.get('LO_WIRE_V2', '1') != '0'}",
+        flush=True,
+    )
+
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache
 

@@ -110,6 +110,12 @@ def _flow_metrics() -> dict:
                     "Seconds decoding wire frames into host columns",
                     labels=("collection",),
                 ),
+                "shm": registry.counter(
+                    "lo_shm_bytes_total",
+                    "Frame bytes served through the shared-memory ring "
+                    "instead of the HTTP body",
+                    labels=("collection",),
+                ),
                 "compile_events": registry.counter(
                     "lo_compile_events_total",
                     "XLA persistent-cache outcomes observed",
@@ -145,6 +151,50 @@ def account_d2h(nbytes: int) -> None:
 def account_decode(collection: str, seconds: float) -> None:
     _flow_metrics()["decode"].labels(collection).inc(seconds)
     _tracing.add_attr("decode_s", round(seconds, 6))
+
+
+def account_shm(collection: str, nbytes: int) -> None:
+    """One frame served through the shared-memory ring (core/shmring.py)
+    — these bytes never rode the HTTP body, so they count here instead
+    of ``lo_wire_bytes_total``."""
+    _flow_metrics()["shm"].labels(collection).inc(nbytes)
+    _tracing.add_attr("shm_bytes", int(nbytes))
+
+
+def flow_totals() -> dict:
+    """Current byte-flow totals summed over label sets — the snapshot
+    bench.py diffs around a measured section (wire/decode/H2D deltas
+    for the warm product build, per-transport wire benchmarks)."""
+    metrics = _flow_metrics()
+    out = {
+        "wire_read_bytes": 0.0,
+        "wire_write_bytes": 0.0,
+        "shm_bytes": 0.0,
+        "decode_s": 0.0,
+        "h2d_bytes": 0.0,
+        "d2h_bytes": 0.0,
+    }
+    wire = metrics["wire"]
+    with wire._lock:
+        for key, child in wire._children.items():
+            out_key = f"wire_{key[0]}_bytes"
+            out[out_key] = out.get(out_key, 0.0) + child.value
+    for out_key, name in (
+        ("shm_bytes", "shm"),
+        ("decode_s", "decode"),
+    ):
+        metric = metrics[name]
+        with metric._lock:
+            out[out_key] = sum(
+                child.value for child in metric._children.values()
+            )
+    for out_key, name in (("h2d_bytes", "h2d"), ("d2h_bytes", "d2h")):
+        metric = metrics[name]
+        with metric._lock:
+            out[out_key] = sum(
+                child.value for child in metric._children.values()
+            )
+    return out
 
 
 def account_compile(

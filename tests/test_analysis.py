@@ -541,6 +541,93 @@ class TestLO104DtypeHygiene:
 
 
 # --------------------------------------------------------------------
+# LO106 — hot-path host copies in core/
+# --------------------------------------------------------------------
+
+_CORE_PATH = "learningorchestra_tpu/core/probe.py"
+
+
+def core_rules_of(source: str) -> set:
+    return {
+        finding.rule
+        for finding in analyze_source(textwrap.dedent(source), _CORE_PATH)
+    }
+
+
+class TestLO106HostCopy:
+    def test_frombuffer_copy_in_core_flagged(self):
+        src = """
+            import numpy as np
+
+            def decode(raw):
+                return np.frombuffer(raw, dtype=np.float64).copy()
+        """
+        assert "LO106" in core_rules_of(src)
+
+    def test_chained_reshape_copy_flagged(self):
+        # frombuffer(b).reshape(-1, w).copy() is the same double pass
+        src = """
+            import numpy as np
+
+            def decode(raw, width):
+                return np.frombuffer(raw, np.float64).reshape(-1, width).copy()
+        """
+        assert "LO106" in core_rules_of(src)
+
+    def test_tobytes_in_core_flagged(self):
+        src = """
+            def encode(column):
+                return column.data.tobytes()
+        """
+        assert "LO106" in core_rules_of(src)
+
+    def test_outside_core_not_flagged(self):
+        # the rule is path-gated: the same code in ml/ is out of scope
+        src = """
+            import numpy as np
+
+            def decode(raw):
+                return np.frombuffer(raw, dtype=np.float64).copy()
+        """
+        assert "LO106" not in {
+            finding.rule
+            for finding in analyze_source(
+                textwrap.dedent(src), "learningorchestra_tpu/ml/probe.py"
+            )
+        }
+
+    def test_plain_copy_not_flagged(self):
+        # .copy() on an owned array is not the wire-decode double pass
+        src = """
+            import numpy as np
+
+            def dup(array):
+                return array.copy()
+        """
+        assert core_rules_of(src) == set()
+
+    def test_view_handoff_not_flagged(self):
+        # the fixed idiom: frombuffer view + reshape, no copy
+        src = """
+            import numpy as np
+
+            def decode(raw, width):
+                return np.frombuffer(raw, np.float64).reshape(-1, width)
+        """
+        assert core_rules_of(src) == set()
+
+    def test_suppression(self):
+        src = """
+            import numpy as np
+
+            def decode(raw):
+                # lo: allow[LO106]
+                return np.frombuffer(raw, dtype=np.uint8).copy()
+        """
+        assert core_rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
 # LO201 — lock acquisition order
 # --------------------------------------------------------------------
 
@@ -1178,13 +1265,21 @@ _BAD_BY_RULE = {
         "        with self._lock:\n"
         "            self._records[name] = b\n"
     ),
+    "LO106": (
+        "import numpy as np\n"
+        "def decode(raw):\n"
+        "    return np.frombuffer(raw, dtype=np.float64).copy()\n"
+    ),
 }
 
 
 class TestCli:
     @pytest.mark.parametrize("rule", sorted(_BAD_BY_RULE))
     def test_each_rule_family_fails_the_cli(self, rule, tmp_path, capsys):
-        path = tmp_path / "bad.py"
+        # a core/ subdir so the path-gated LO106 is in scope; the other
+        # rules are path-independent
+        (tmp_path / "core").mkdir(exist_ok=True)
+        path = tmp_path / "core" / "bad.py"
         path.write_text(_BAD_BY_RULE[rule])
         assert cli_main([str(path)]) == 1
         assert rule in capsys.readouterr().out

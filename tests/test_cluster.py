@@ -538,6 +538,50 @@ class TestCoalescingManifest:
             load({"window_ms": "2"})
 
 
+class TestWireManifest:
+    def test_wire_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["wire"] = {"shm_bytes": 268435456, "dtype_policy": "bf16"}
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # dtype_policy must match on EVERY host
+            env = plan["env"]
+            assert env["LO_SHM_BYTES"] == "268435456"
+            assert env["LO_DTYPE_POLICY"] == "bf16"
+
+    def test_wire_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(wire):
+            manifest = _manifest()
+            manifest["wire"] = wire
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # shm_bytes 0 = transport off: valid; f32 policy: valid
+        loaded = load({"shm_bytes": 0, "dtype_policy": "f32"})
+        assert loaded["wire"]["shm_bytes"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"shm_bytes": -1})
+        with pytest.raises(SystemExit):
+            load({"shm_bytes": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"shm_bytes": "1e9"})  # bytes are integers
+        with pytest.raises(SystemExit):
+            load({"shm_bytes": 0.5})
+        with pytest.raises(SystemExit):
+            load({"dtype_policy": "f16"})  # only f32 | bf16
+        with pytest.raises(SystemExit):
+            load({"dtype_policy": 1})
+        with pytest.raises(SystemExit):
+            load({"dtype_policy": True})
+
+
 class TestServingManifest:
     def test_serving_section_plumbs_env_cluster_wide(self, tmp_path):
         cluster = _load_cluster_module()

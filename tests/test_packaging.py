@@ -51,6 +51,13 @@ def test_pip_install_provides_reference_client_surface(tmp_path):
         "import learningorchestra_tpu.telemetry.profile as prof\n"
         "assert callable(prof.chrome_trace)\n"
         "assert callable(prof.sample_stacks)\n"
+        # the zero-copy wire (frame v2 + shm ring + dtype policy) ships
+        # installed and imports without jax
+        "import learningorchestra_tpu.core.shmring as shmring\n"
+        "assert callable(shmring.shm_bytes)\n"
+        "from learningorchestra_tpu.core.wire import MAGIC_V2\n"
+        "from learningorchestra_tpu.utils.dtypepolicy import dtype_policy\n"
+        "assert dtype_policy() in ('f32', 'bf16')\n"
         "print('client surface ok')\n"
     )
     env = dict(os.environ)

@@ -1,0 +1,565 @@
+"""Zero-copy columnar wire: frame v2, shm ring, negotiation, dtype policy.
+
+The contract under test (docs/dataplane.md):
+
+- golden encode/decode round-trips across every column kind and mask
+  combination, v2 bit-identical to v1;
+- v2 decode hands out 64-byte-aligned read-only VIEWS over one backing
+  buffer (one FrameOwner per frame), and a caller mutating a decoded
+  column copies first — the pinned frame can never be corrupted;
+- v1↔v2 negotiation against a live store server (old client, old
+  server, both simulated through the Accept header / wire_v2 flag);
+- the shared-memory ring serves co-located reads without an HTTP body,
+  and falls back to the body transparently when the segment is absent;
+- a full histogram→build→predict pipeline returns identical results
+  over every transport.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core import shmring, wire
+from learningorchestra_tpu.core import devcache
+from learningorchestra_tpu.core.columns import MISSING, Column
+from learningorchestra_tpu.core.store import InMemoryStore
+from learningorchestra_tpu.core.store_service import (
+    RemoteStore,
+    create_store_app,
+)
+from learningorchestra_tpu.utils.web import ServerThread
+
+
+def same_cells(a: list, b: list) -> bool:
+    """Cell equality with NaN == NaN (bit-preservation is the contract;
+    Python's ``==`` would call equal NaNs different)."""
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, float) and isinstance(y, float):
+            if x != y and not (np.isnan(x) and np.isnan(y)):
+                return False
+        elif isinstance(x, list) and isinstance(y, list):
+            if not same_cells(x, y):
+                return False
+        elif x != y:
+            return False
+    return True
+
+
+def golden_columns() -> dict[str, Column]:
+    """Every kind, every mask: f8 (NaN-as-null), i8, num (+intm, none,
+    miss), bool, str (unicode, none, miss), vec (+NaN row), obj (+miss),
+    empty (all-pads)."""
+    vec = np.arange(8.0).reshape(4, 2)
+    vec[2, 1] = np.nan  # NaN cell nulls the row vector (f8 parity)
+    return {
+        "f8": Column.from_values([1.0, None, float("nan"), 3.5]),
+        "i8": Column.from_values([1, -2, 3, 4]),
+        "num": Column.from_values([1, 2.5, None, MISSING]),
+        "bool": Column.from_values([True, False, True, False]),
+        "str": Column.from_values(["a", None, "日本語", MISSING]),
+        "vec": Column.from_numpy(vec),
+        "obj": Column.from_values([{"x": 1}, None, [1, 2], MISSING]),
+        "empty": Column.pads(4),
+    }
+
+
+class TestFrameV2Golden:
+    def test_v2_roundtrip_matches_v1_and_source(self):
+        cols = golden_columns()
+        v1 = wire.decode_frame(wire.encode_frame(cols, {"rev": 3}))
+        v2 = wire.decode_frame(wire.encode_frame(cols, {"rev": 3}, version=2))
+        assert v1[1] == v2[1] == {"rev": 3}
+        for name, column in cols.items():
+            want = column.tolist(pad_as_none=False)
+            assert same_cells(v1[0][name].tolist(pad_as_none=False), want), name
+            assert same_cells(v2[0][name].tolist(pad_as_none=False), want), name
+
+    def test_v2_numeric_buffers_bit_identical_to_v1(self):
+        cols = golden_columns()
+        v1, _ = wire.decode_frame(wire.encode_frame(cols))
+        v2, _ = wire.decode_frame(wire.encode_frame(cols, version=2))
+        for name in ("f8", "i8", "num", "bool", "vec"):
+            a, b = v1[name], v2[name]
+            # bit-level equality, NaN slots included
+            assert (
+                np.asarray(a.data).tobytes() == np.asarray(b.data).tobytes()
+            ), name
+            for slot in ("none", "miss", "intm"):
+                ma, mb = getattr(a, slot), getattr(b, slot)
+                assert (ma is None) == (mb is None), (name, slot)
+                if ma is not None:
+                    assert np.array_equal(ma, mb), (name, slot)
+
+    def test_v2_views_are_aligned_and_share_one_owner(self):
+        cols = golden_columns()
+        decoded, _ = wire.decode_frame(wire.encode_frame(cols, version=2))
+        owners = set()
+        for name in ("f8", "i8", "num", "bool", "str", "vec"):
+            column = decoded[name]
+            assert column.owner is not None, name
+            owners.add(id(column.owner))
+            assert column.data.ctypes.data % wire.ALIGN == 0, name
+            if column.offsets is not None:
+                assert column.offsets.ctypes.data % wire.ALIGN == 0, name
+        assert len(owners) == 1  # ONE backing buffer for the frame
+
+    def test_zero_rows_roundtrip(self):
+        cols = {
+            "f": Column.from_values([]),
+            "s": Column.from_strings([]),
+        }
+        for version in (1, 2):
+            decoded, _ = wire.decode_frame(
+                wire.encode_frame(cols, version=version)
+            )
+            assert decoded["f"].tolist() == []
+            assert decoded["s"].tolist() == []
+
+    def test_unknown_magic_rejected(self):
+        with pytest.raises(ValueError):
+            wire.decode_frame(b"LOCB9\n" + b"\0" * 32)
+
+    @pytest.mark.parametrize("version", (1, 2))
+    def test_zero_dimension_vec_roundtrip(self, version):
+        # (0, w) buffers come from beyond-the-end paged chunks (the
+        # speculative terminal fetch); (n, 0) from width-0 vectors —
+        # memoryview.cast rejects zero-in-shape views, so encode must
+        # short-circuit them, and decode must still CONSUME the empty
+        # data buffer or every following mask lands on the wrong slot
+        empty_rows = Column.from_numpy(np.empty((0, 3), dtype=np.float64))
+        width_zero = Column.from_numpy(np.empty((2, 0), dtype=np.float64))
+        width_zero = width_zero.set(0, None)  # a mask AFTER the data slot
+        frame = wire.encode_frame(
+            {"a": empty_rows, "b": width_zero}, version=version
+        )
+        decoded, _ = wire.decode_frame(frame)
+        assert decoded["a"].tolist() == []
+        assert decoded["b"].tolist() == [None, []]
+
+    def test_width_zero_vec_mask_survives_wal_roundtrip(self):
+        # the WAL/replication path (to_json_record) for a width-0 vec
+        # with a null mask: the mask must round-trip exactly, not be
+        # rebuilt from the adjacent (empty) data buffer
+        column = Column.from_numpy(np.empty((3, 0), dtype=np.float64))
+        column = column.set(0, None)
+        column = column.set(2, None)
+        back = Column.from_json_record(column.to_json_record())
+        assert back.tolist() == [None, [], None]
+        assert np.array_equal(back.none, [True, False, True])
+
+    @pytest.mark.parametrize("version", (1, 2))
+    def test_truncated_frame_raises_never_decodes_short(self, version):
+        # v2's aligned layout can land a truncation on a dtype-size
+        # boundary: a torn frame must RAISE (the chunk-retry machinery
+        # re-fetches), never hand back silently short columns
+        cols = {"a": Column.from_values(list(range(100)))}
+        frame = wire.encode_frame(cols, version=version)
+        for cut in (len(frame) // 2, len(frame) - 8):
+            with pytest.raises(ValueError):
+                wire.decode_frame(frame[:cut])
+
+
+class TestMutationSafety:
+    def test_set_copies_instead_of_corrupting_the_frame(self):
+        frame = wire.encode_frame(golden_columns(), version=2)
+        decoded, _ = wire.decode_frame(frame)
+        column = decoded["f8"]
+        owner = column.owner
+        before = bytes(owner.base)
+        mutated = column.set(0, 99.0)
+        assert mutated.get(0) == 99.0
+        assert bytes(owner.base) == before  # frame untouched
+        assert mutated.owner is None  # the copy no longer pins it
+
+    def test_direct_write_through_the_view_raises(self):
+        decoded, _ = wire.decode_frame(
+            wire.encode_frame(golden_columns(), version=2)
+        )
+        with pytest.raises(ValueError):
+            decoded["f8"].data[0] = 5.0
+
+    def test_append_after_zero_copy_decode(self):
+        # the paged-read loop appends chunk columns (including a
+        # terminal empty chunk) into zero-copy columns
+        first, _ = wire.decode_frame(
+            wire.encode_frame({"s": Column.from_values(["x", "y"])}, version=2)
+        )
+        second, _ = wire.decode_frame(
+            wire.encode_frame({"s": Column.from_values(["z"])}, version=2)
+        )
+        empty, _ = wire.decode_frame(
+            wire.encode_frame({"s": Column.from_values([])}, version=2)
+        )
+        merged = (
+            first["s"].append_column(second["s"]).append_column(empty["s"])
+        )
+        assert merged.tolist() == ["x", "y", "z"]
+
+    def test_to_float64_view_is_read_only_but_consumable(self):
+        decoded, _ = wire.decode_frame(
+            wire.encode_frame(
+                {"x": Column.from_values([1.0, 2.0, 3.0])}, version=2
+            )
+        )
+        out = decoded["x"].to_float64()
+        assert not out.flags.writeable  # mask-free f8: the view itself
+        assert np.stack([out], axis=1).flags.writeable  # consumers copy
+
+    def test_to_float64_isolated_from_later_column_writes(self):
+        # zero-copy hand-off must keep the old copy semantics' ISOLATION:
+        # mutating the column after taking the matrix view copies first
+        # (COW), and writing into the "matrix" raises instead of
+        # corrupting the store
+        column = Column.from_values([1.0, 2.0, 3.0])
+        matrix = column.to_float64()
+        column = column.set(0, 99.0)
+        assert matrix.tolist() == [1.0, 2.0, 3.0]
+        assert column.get(0) == 99.0
+        with pytest.raises(ValueError):
+            matrix[1] = -1.0
+
+    def test_append_zero_byte_string_chunk_onto_view(self):
+        # a chunk with ROWS but zero string bytes (all-empty strings)
+        # appended onto a read-only zero-copy STR column: the no-growth
+        # path must not slice-assign into the read-only view
+        base, _ = wire.decode_frame(
+            wire.encode_frame({"s": Column.from_values(["x", "y"])}, version=2)
+        )
+        hollow, _ = wire.decode_frame(
+            wire.encode_frame({"s": Column.from_values(["", ""])}, version=2)
+        )
+        merged = base["s"].append_column(hollow["s"])
+        assert merged.tolist() == ["x", "y", "", ""]
+
+
+@pytest.fixture()
+def wire_server():
+    devcache.reset_global_devcache()
+    server = ServerThread(
+        create_store_app(InMemoryStore(), shm=True), "127.0.0.1", 0
+    ).start()
+    yield server
+    server.stop()
+    devcache.reset_global_devcache()
+
+
+def _seed(client: RemoteStore, rows: int = 5000) -> None:
+    client.create_collection("wired")
+    client.insert_columns(
+        "wired",
+        {
+            "x": [float(i) for i in range(rows)],
+            "y": [None if i % 97 == 0 else i * 0.5 for i in range(rows)],
+            "tag": [f"t{i % 13}" for i in range(rows)],
+        },
+        start_id=1,
+    )
+
+
+class TestNegotiation:
+    def test_v1_and_v2_clients_read_identically(self, wire_server):
+        url = f"http://127.0.0.1:{wire_server.port}"
+        writer = RemoteStore(url, shm_bytes=0)
+        _seed(writer)
+        v2 = RemoteStore(url, shm_bytes=0)
+        v1 = RemoteStore(url, wire_v2=False, shm_bytes=0)  # old client
+        a = v2.read_column_arrays("wired")
+        b = v1.read_column_arrays("wired")
+        for name in a:
+            assert a[name].tolist() == b[name].tolist(), name
+
+    def test_server_health_advertises_bin2(self, wire_server):
+        from learningorchestra_tpu.core.store_service import probe_health
+
+        health = probe_health(f"http://127.0.0.1:{wire_server.port}")
+        assert health["columns_wire"] == "bin2"
+        assert health["shm"] is True
+
+    def test_old_server_still_understood(self, wire_server):
+        # an old server never emits v2: simulated by a client that does
+        # not advertise (wire_v2=False) — the decode dispatches on the
+        # magic, so the v1 body round-trips
+        url = f"http://127.0.0.1:{wire_server.port}"
+        client = RemoteStore(url, wire_v2=False, shm_bytes=0)
+        _seed(client, rows=100)
+        assert client._upload_version() == 1
+        got = client.read_column_arrays("wired")
+        assert got["x"].tolist()[:3] == [0.0, 1.0, 2.0]
+
+    def test_v2_upload_after_health_probe(self, wire_server):
+        url = f"http://127.0.0.1:{wire_server.port}"
+        client = RemoteStore(url, shm_bytes=0)
+        assert client._upload_version() == 2
+        _seed(client, rows=100)
+        assert client.read_column_arrays("wired")["tag"].tolist()[:2] == [
+            "t0",
+            "t1",
+        ]
+
+    def test_upload_version_reprobes_after_failover(self):
+        # a rolling upgrade can fail a bin2 primary over onto an older
+        # peer: the cached upload version must be re-probed at the new
+        # server, never carried across the re-point
+        first = ServerThread(
+            create_store_app(InMemoryStore()), "127.0.0.1", 0
+        ).start()
+        second = ServerThread(
+            create_store_app(InMemoryStore()), "127.0.0.1", 0
+        ).start()
+        try:
+            client = RemoteStore(
+                f"http://127.0.0.1:{first.port},"
+                f"http://127.0.0.1:{second.port}",
+                shm_bytes=0,
+                failover_timeout=10,
+            )
+            assert client._upload_version() == 2
+            first.stop()
+            client.insert_one("ds", {"_id": 1, "x": 1})  # rides failover
+            assert client.base_url.endswith(str(second.port))
+            assert client._upload_version_cache is None  # re-probe due
+            assert client._upload_version() == 2  # probed at the peer
+            client.insert_columns("ds", {"y": [1.0, 2.0]}, start_id=2)
+            assert client.count("ds") == 3
+        finally:
+            second.stop()
+
+
+class TestShmRing:
+    def test_shm_read_equals_body_read(self, wire_server):
+        url = f"http://127.0.0.1:{wire_server.port}"
+        writer = RemoteStore(url, shm_bytes=0)
+        _seed(writer)
+        shm = RemoteStore(url, shm_bytes=8_000_000)
+        plain = RemoteStore(url, shm_bytes=0)
+        try:
+            a = shm.read_column_arrays("wired")
+            b = plain.read_column_arrays("wired")
+            for name in b:
+                assert a[name].tolist() == b[name].tolist(), name
+            stats = shm.shm_stats()
+            assert stats["frames"] >= 1 and stats["bytes"] > 0
+        finally:
+            shm.close()
+
+    def test_absent_segment_falls_back_to_body(self, wire_server):
+        url = f"http://127.0.0.1:{wire_server.port}"
+        writer = RemoteStore(url, shm_bytes=0)
+        _seed(writer, rows=500)
+        client = RemoteStore(url, shm_bytes=8_000_000)
+        try:
+            ring = client._ring()
+            ring.name = "lo_bogus_segment_gone"  # server cannot attach
+            got = client.read_column_arrays("wired")
+            assert got["x"].tolist()[:3] == [0.0, 1.0, 2.0]
+            assert client.shm_stats()["frames"] == 0  # body road taken
+        finally:
+            client.close()
+
+    def test_shm_disabled_server_side(self):
+        # LO_SHM_BYTES=0 on the server: the client advertises, the
+        # server ignores, bytes ride the body
+        devcache.reset_global_devcache()
+        server = ServerThread(
+            create_store_app(InMemoryStore(), shm=False), "127.0.0.1", 0
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            client = RemoteStore(url, shm_bytes=8_000_000)
+            _seed(client, rows=500)
+            got = client.read_column_arrays("wired")
+            assert got["x"].tolist()[:3] == [0.0, 1.0, 2.0]
+            assert (client.shm_stats() or {"frames": 0})["frames"] == 0
+            client.close()
+        finally:
+            server.stop()
+
+    def test_oversized_frame_falls_back(self, wire_server):
+        # ring smaller than one frame: every read takes the body road
+        url = f"http://127.0.0.1:{wire_server.port}"
+        writer = RemoteStore(url, shm_bytes=0)
+        _seed(writer)
+        client = RemoteStore(url, shm_bytes=4096)
+        try:
+            got = client.read_column_arrays("wired")
+            assert len(got["x"]) == 5000
+            assert client.shm_stats()["frames"] == 0
+        finally:
+            client.close()
+
+    def test_torn_slot_detected(self):
+        # ring sized so the SECOND place wraps onto the first slot: the
+        # stale coordinates must refuse, not hand back other data
+        ring = shmring.ClientRing(1 << 12)
+        try:
+            rings = shmring.ServerRings()
+            frame = wire.encode_frame(
+                {"x": Column.from_values([float(i) for i in range(300)])},
+                version=2,
+            )
+            assert len(frame) * 2 > ring.nbytes  # forces the wrap
+            offset, length, generation = rings.place(
+                ring.name, ring.nbytes, frame
+            )
+            fresh = rings.place(ring.name, ring.nbytes, frame)
+            assert fresh[0] == offset  # wrapped onto the first slot
+            got = ring.read(*fresh)
+            assert len(got) == len(frame)
+            with pytest.raises(shmring.ShmTornError):
+                ring.read(offset, length, generation)
+            rings.close()
+        finally:
+            ring.close()
+
+    def test_path_shaped_segment_names_rejected(self, tmp_path):
+        # a request header must never point the server's mmap at an
+        # arbitrary writable file (traversal / absolute paths)
+        victim = tmp_path / "victim.bin"
+        victim.write_bytes(b"\0" * 4096)
+        rings = shmring.ServerRings()
+        frame = b"x" * 64
+        for name in (
+            f"../..{victim}",
+            str(victim),
+            "a/b",
+            "..",
+            ".hidden",
+            "",
+        ):
+            assert rings.place(name, 4096, frame) is None, name
+        assert victim.read_bytes() == b"\0" * 4096  # untouched
+        with pytest.raises(ValueError):
+            shmring._Attachment("../etc/hosts")
+        rings.close()
+
+    def test_attach_cache_evicts_oldest_not_newest(self):
+        # LRU contract: with the cache full, attaching one more evicts
+        # the OLDEST segment; the newest stays served from cache
+        rings = shmring.ServerRings()
+        rings.MAX_SEGMENTS = 2
+        clients = [shmring.ClientRing(1 << 14) for _ in range(3)]
+        try:
+            frame = b"y" * 32
+            assert rings.place(clients[0].name, 1 << 14, frame)
+            assert rings.place(clients[1].name, 1 << 14, frame)
+            with rings._lock:
+                assert list(rings._segments) == [
+                    clients[0].name,
+                    clients[1].name,
+                ]
+            assert rings.place(clients[2].name, 1 << 14, frame)
+            with rings._lock:
+                names = list(rings._segments)
+            assert clients[0].name not in names  # oldest evicted
+            assert clients[1].name in names and clients[2].name in names
+        finally:
+            rings.close()
+            for client in clients:
+                client.close()
+
+    def test_shm_bytes_env_validation(self, monkeypatch):
+        monkeypatch.setenv("LO_SHM_BYTES", "1e6")
+        assert shmring.shm_bytes() == 1_000_000
+        monkeypatch.setenv("LO_SHM_BYTES", "0")
+        assert shmring.shm_bytes() == 0
+        monkeypatch.setenv("LO_SHM_BYTES", "-5")
+        with pytest.raises(ValueError):
+            shmring.shm_bytes()
+        monkeypatch.setenv("LO_SHM_BYTES", "lots")
+        with pytest.raises(ValueError):
+            shmring.shm_bytes()
+
+
+PREPROCESSOR = (
+    "from pyspark.ml.feature import VectorAssembler\n"
+    "feature_cols = [c for c in training_df.schema.names if c != 'label']\n"
+    "assembler = VectorAssembler(inputCols=feature_cols, "
+    "outputCol='features')\n"
+    "features_training = assembler.transform(training_df)\n"
+    "features_testing = assembler.transform(testing_df)\n"
+    "features_evaluation = assembler.transform(testing_df)\n"
+)
+
+
+class TestPipelineEquivalence:
+    """Acceptance: a full histogram→build→predict pipeline over each
+    transport returns identical results (zero-copy equivalence at the
+    workload level, not just the frame level)."""
+
+    @pytest.fixture()
+    def seeded_server(self):
+        devcache.reset_global_devcache()
+        server = ServerThread(
+            create_store_app(InMemoryStore(), shm=True), "127.0.0.1", 0
+        ).start()
+        url = f"http://127.0.0.1:{server.port}"
+        rng = np.random.default_rng(3)
+        rows = 400
+        X = rng.random((rows, 4))
+        y = (X[:, 0] + X[:, 1] > 1.0).astype(int)
+        writer = RemoteStore(url, shm_bytes=0)
+        for name in ("wtrain", "wtest"):
+            writer.create_collection(name)
+            writer.insert_one(
+                name,
+                {
+                    "_id": 0,
+                    "filename": name,
+                    "finished": True,
+                    "fields": [f"f{i}" for i in range(4)] + ["label"],
+                },
+            )
+            columns = {f"f{i}": X[:, i].tolist() for i in range(4)}
+            columns["label"] = y.tolist()
+            writer.insert_columns(name, columns)
+        yield url
+        server.stop()
+        devcache.reset_global_devcache()
+
+    def test_identical_over_every_transport(self, seeded_server):
+        from learningorchestra_tpu.ml.builder import build_model
+
+        url = seeded_server
+        outputs = {}
+        for label, client in (
+            ("v1", RemoteStore(url, wire_v2=False, shm_bytes=0)),
+            ("v2", RemoteStore(url, shm_bytes=0)),
+            ("shm", RemoteStore(url, shm_bytes=8_000_000)),
+        ):
+            # each client is its own devcache scope (fresh store
+            # token), so no transport's read is served from another's
+            # cache entry
+            histogram = client.aggregate(
+                "wtrain", [{"$group": {"_id": "$label", "count": {}}}]
+            )
+            results = build_model(
+                client, "wtrain", "wtest", PREPROCESSOR, ["lr", "nb"]
+            )
+            predictions = {
+                r["classificator"]: sorted(
+                    (
+                        (doc["_id"], doc["prediction"])
+                        for doc in client.find(
+                            f"wtest_prediction_{r['classificator']}"
+                        )
+                        if doc["_id"] != 0
+                    )
+                )
+                for r in results
+            }
+            metrics = {
+                r["classificator"]: (r["accuracy"], r["F1"])
+                for r in results
+            }
+            outputs[label] = (
+                sorted(
+                    (entry["_id"], entry["count"]) for entry in histogram
+                ),
+                predictions,
+                metrics,
+            )
+            client.close()
+        assert outputs["v1"] == outputs["v2"] == outputs["shm"]
