@@ -681,6 +681,252 @@ def bench_serve() -> dict:
         shutil.rmtree(models_dir, ignore_errors=True)
 
 
+def _rss_bytes() -> int:
+    """Current resident set (bytes) from /proc — ru_maxrss is a peak,
+    not a level, so it cannot see waiters RELEASING memory."""
+    with open("/proc/self/statm") as handle:
+        return int(handle.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+
+def _waiter_job(release) -> str:
+    """A tracked job that stays running until the bench releases it —
+    the thing /wait waiters park on."""
+    release.wait(180)
+    return "released"
+
+
+def bench_waiters() -> dict:
+    """Waiters section: push job completion on the event-loop serving
+    core (docs/web.md). Two claims, measured:
+
+    - **capacity**: N idle ``GET /jobs/<name>/wait`` connections parked
+      on the async core cost O(1) threads and bytes-per-waiter of
+      marginal RSS; the threaded escape hatch holds a (much smaller) M
+      at one blocked thread each for the per-waiter head-to-head. Both
+      arms count the client sockets too (same process), so the DELTA
+      between arms is the honest thread-stack bill.
+    - **notify latency**: client-observed finish-to-notified p50/p99
+      for the three waiting styles — reference-cadence metadata polling
+      (3 s), ``/wait`` long-poll, ``/wait`` SSE. Trials run
+      concurrently so the poll arm's expected ~1.5 s mean does not
+      serialize into the budget.
+    """
+    import gc
+    import socket as socket_mod
+    import threading
+
+    import requests
+
+    from learningorchestra_tpu.core.jobs import JobManager
+    from learningorchestra_tpu.sched.scheduler import Scheduler
+    from learningorchestra_tpu.utils import webloop
+    from learningorchestra_tpu.utils.web import WebApp
+
+    n_async = int(os.environ.get("LO_BENCH_WAITERS", "1000"))
+    n_threaded = min(64, n_async)
+    trials = int(os.environ.get("LO_BENCH_WAIT_TRIALS", "24"))
+    poll_trials = min(16, trials)
+    poll_interval_s = 3.0  # the reference client's cadence
+    app = WebApp("bench_waiters")
+    jobs = JobManager(
+        scheduler=Scheduler(host_width=trials + 4, queue_cap=4 * trials + 16)
+    )
+    app.register_job_routes(jobs)
+    out: dict = {"capacity": {}, "notify": {}}
+
+    def capacity(server_port, parked_check, count, job_name):
+        """Park ``count`` /wait connections on a running job; read RSS
+        and thread level before vs while-parked, then release the job
+        and drain the notifications."""
+        release = threading.Event()
+        jobs.submit(job_name, _waiter_job, release)
+        request_bytes = (
+            f"GET /jobs/{job_name}/wait?timeout=55 HTTP/1.1\r\n"
+            f"Host: bench\r\nConnection: close\r\n\r\n"
+        ).encode()
+        gc.collect()
+        rss_before = _rss_bytes()
+        threads_before = threading.active_count()
+        socks = []
+        try:
+            for _ in range(count):
+                sock = socket_mod.create_connection(
+                    ("127.0.0.1", server_port), timeout=30
+                )
+                sock.settimeout(30)
+                sock.sendall(request_bytes)
+                socks.append(sock)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not parked_check(
+                count, threads_before
+            ):
+                time.sleep(0.05)
+            gc.collect()
+            rss_parked = _rss_bytes()
+            threads_parked = threading.active_count()
+            release.set()
+            start = time.perf_counter()
+            delivered = 0
+            for sock in socks:
+                try:
+                    if sock.recv(1024):
+                        delivered += 1
+                except OSError:
+                    pass
+            drain_s = time.perf_counter() - start
+        finally:
+            release.set()
+            for sock in socks:
+                sock.close()
+        return {
+            "waiters": count,
+            "delivered": delivered,
+            "threads_before": threads_before,
+            "threads_parked": threads_parked,
+            "threads_added": threads_parked - threads_before,
+            "rss_added_mb": round((rss_parked - rss_before) / 1e6, 2),
+            "rss_per_waiter_bytes": max(
+                0, round((rss_parked - rss_before) / count)
+            ),
+            "drain_s": round(drain_s, 4),
+        }
+
+    def measure_mode(base_url, mode, count):
+        """``count`` concurrent waiters, one tracked job each; release
+        the jobs one at a time and record client-observed latency."""
+        releases = [threading.Event() for _ in range(count)]
+        names = [f"bench-wait-{mode}-{i}" for i in range(count)]
+        for name, release in zip(names, releases):
+            jobs.submit(name, _waiter_job, release)
+        observed: list = [None] * count
+        errors: list = []
+
+        def wait_poll(name):
+            while True:
+                response = requests.get(f"{base_url}/jobs/{name}", timeout=10)
+                record = response.json()["result"]
+                if record.get("state") in ("finished", "failed", "cancelled"):
+                    return time.perf_counter()
+                time.sleep(poll_interval_s)
+
+        def wait_longpoll(name):
+            while True:
+                response = requests.get(
+                    f"{base_url}/jobs/{name}/wait",
+                    params={"timeout": "30"},
+                    timeout=40,
+                )
+                payload = response.json()["result"]
+                if payload != "timeout":
+                    return time.perf_counter()
+
+        def wait_sse(name):
+            response = requests.get(
+                f"{base_url}/jobs/{name}/wait",
+                params={"timeout": "30"},
+                headers={"Accept": "text/event-stream"},
+                stream=True,
+                timeout=40,
+            )
+            for line in response.iter_lines():
+                if line.startswith(b"event:"):
+                    return time.perf_counter()
+            raise RuntimeError("SSE stream ended without an event")
+
+        wait_fn = {"poll": wait_poll, "longpoll": wait_longpoll,
+                   "sse": wait_sse}[mode]
+
+        def client(index):
+            try:
+                observed[index] = wait_fn(names[index])
+            except Exception as error:  # noqa: BLE001 — tallied below
+                errors.append(f"{type(error).__name__}: {error}")
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(count)
+        ]
+        for thread in threads:
+            thread.start()
+        time.sleep(0.8)  # everyone parked / into their first poll sleep
+        finished_at = []
+        for release in releases:
+            finished_at.append(time.perf_counter())
+            release.set()
+            time.sleep(0.01)
+        for thread in threads:
+            thread.join(timeout=60)
+        latencies_ms = [
+            (observed[i] - finished_at[i]) * 1000.0
+            for i in range(count)
+            if observed[i] is not None
+        ]
+        entry = {"trials": count, "failed": count - len(latencies_ms)}
+        if errors:
+            entry["first_error"] = errors[0]
+        if latencies_ms:
+            entry["notify_p50_ms"] = round(
+                float(np.percentile(latencies_ms, 50)), 2
+            )
+            entry["notify_p99_ms"] = round(
+                float(np.percentile(latencies_ms, 99)), 2
+            )
+        return entry
+
+    # --- async arm: the product configuration -----------------------------
+    server = webloop.LoopServer(app, "127.0.0.1", 0).start()
+    base_url = f"http://127.0.0.1:{server.port}"
+    try:
+        out["capacity"]["async"] = capacity(
+            server.port,
+            lambda count, _level: server.waiter_count >= count,
+            n_async,
+            "bench-capacity-async",
+        )
+        for mode, count in (
+            ("longpoll", trials), ("sse", trials), ("poll", poll_trials)
+        ):
+            if mode == "poll" and _budget_left() < 30:
+                out["notify"][mode] = {"skipped": "budget"}
+                continue
+            out["notify"][mode] = measure_mode(base_url, mode, count)
+        out["notify"]["poll_interval_s"] = poll_interval_s
+    finally:
+        server.stop()
+
+    # --- threaded escape-hatch arm: a thread per parked waiter ------------
+    if _budget_left() > 30:
+        from werkzeug.serving import make_server
+
+        threaded = make_server("127.0.0.1", 0, app, threaded=True)
+        thread = threading.Thread(target=threaded.serve_forever, daemon=True)
+        thread.start()
+        try:
+            out["capacity"]["threaded"] = capacity(
+                threaded.server_port,
+                # no parked counter on werkzeug: the handler threads it
+                # spawned (one per blocked waiter) are the signal
+                lambda count, level: threading.active_count()
+                >= level + count,
+                n_threaded,
+                "bench-capacity-threaded",
+            )
+        finally:
+            threaded.shutdown()
+            thread.join(timeout=5)
+        async_arm = out["capacity"]["async"]
+        threaded_arm = out["capacity"]["threaded"]
+        if threaded_arm["rss_per_waiter_bytes"]:
+            out["capacity"]["rss_per_waiter_ratio"] = round(
+                threaded_arm["rss_per_waiter_bytes"]
+                / max(async_arm["rss_per_waiter_bytes"], 1),
+                2,
+            )
+    else:
+        out["capacity"]["threaded"] = {"skipped": "budget"}
+    jobs.scheduler.close()
+    return out
+
+
 def bench_coalesce() -> dict:
     """Coalesce section: the scheduler's vmap-across-jobs stage
     (sched/coalesce.py) under the ISSUE's two workloads. Both flood
@@ -1059,8 +1305,13 @@ _HIGHER_IS_BETTER = (
 # byte-flow totals that gate DOWN (checked before the generic "bytes"
 # fact token below eats them): wire and H2D traffic for the same
 # workload growing past threshold means a copy/transfer crept back
-# into the data plane (the zero-copy wire PR's regression gate)
-_LOWER_PRIORITY = ("wire_read_bytes", "wire_write_bytes", "h2d_bytes")
+# into the data plane (the zero-copy wire PR's regression gate);
+# rss_per_waiter is the event-loop core's marginal cost per parked
+# /wait connection — growing past threshold means per-connection state
+# crept back toward a thread stack (docs/web.md)
+_LOWER_PRIORITY = (
+    "wire_read_bytes", "wire_write_bytes", "h2d_bytes", "rss_per_waiter",
+)
 _LOWER_IS_BETTER = ("_s", "_ms", "seconds", "p50_ms", "p99_ms")
 # numeric facts that are not performance (never gated, still diffed)
 _UNGATED = (
@@ -1069,6 +1320,11 @@ _UNGATED = (
     "subsample", "requests_per_client", "rows_per_request", "landmarks",
     "macro_rows", "count", "depth", "capacity", "models", "peak",
     "flops", "value", "rejected", "samples", "hz", "overhead_pct",
+    # waiters facts: parked/delivered counts, thread levels, the
+    # interval knob, and the 1000-notify drain (too fast and too
+    # jittery at ~0.1 s to gate at a 25% threshold honestly)
+    "waiters", "delivered", "threads", "drain", "trials", "failed",
+    "poll_interval",
 )
 # absolute floor below which a time-like delta is timer noise, not a
 # regression (0.011s "doubling" to 0.022s must not fail a round). The
@@ -1270,6 +1526,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
         )
     section("wire", bench_wire)  # transport head-to-head (v1/v2/shm)
     section("serve", bench_serve)  # the online predict lane's latency
+    section("waiters", bench_waiters)  # push job completion (docs/web.md)
     section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch
     section("embeddings", bench_embeddings)
     section("kernels_wide", bench_kernels_wide)
@@ -1321,6 +1578,17 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
                 "p99_ms": top.get("p99_ms"),
                 "predictions_per_s": top.get("predictions_per_s"),
                 "mean_batch_size": top.get("mean_batch_size"),
+            }
+    waiters = extra.get("waiters")
+    if isinstance(waiters, dict):
+        longpoll = waiters.get("notify", {}).get("longpoll", {})
+        async_arm = waiters.get("capacity", {}).get("async", {})
+        if isinstance(longpoll, dict) and isinstance(async_arm, dict):
+            summary["waiters"] = {
+                "notify_p99_ms": longpoll.get("notify_p99_ms"),
+                "parked": async_arm.get("waiters"),
+                "threads_added": async_arm.get("threads_added"),
+                "rss_per_waiter_bytes": async_arm.get("rss_per_waiter_bytes"),
             }
     embeddings = extra.get("embeddings")
     if isinstance(embeddings, dict):

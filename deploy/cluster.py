@@ -71,6 +71,12 @@ Manifest (JSON)::
         "prof_hz": 47,             #   LO_PROF_HZ (0 disables /debug/
         "prof_window_s": 60        #   profile); LO_PROF_WINDOW_S (> 0)
       },
+      "web": {                     # optional web-serving knobs
+        "async": 1,                #   LO_WEB_ASYNC (0 = threaded
+        "handlers": 8,             #   escape hatch); LO_WEB_HANDLERS
+        "max_conns": 10000,        #   (>= 1); LO_WEB_MAX_CONNS (503
+        "wait_cap_s": 60           #   past it); LO_WEB_WAIT_CAP_S (> 0)
+      },
       "replication": {             # optional replicated store plane
         "enabled": true,           #   (docs/replication.md): the head
         "follower_port": 27028,    #   runs primary + WAL-shipping
@@ -245,6 +251,26 @@ def load_manifest(path: str) -> dict:
                 )
         elif key == "prof_window_s" and value <= 0:
             raise SystemExit("profiling.prof_window_s must be > 0")
+    web = manifest.setdefault("web", {})
+    for key in web:
+        if key not in _WEB_KNOBS:
+            raise SystemExit(
+                f"unknown web knob {key!r} (have: "
+                f"{', '.join(sorted(_WEB_KNOBS))})"
+            )
+        value = web[key]
+        # same bool-is-int trap as the sched knobs: `"async": true`
+        # would stringify to "True" and fail every preflight downstream
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SystemExit(f"web.{key} must be a number")
+        if key == "async":
+            if value not in (0, 1):
+                raise SystemExit("web.async must be 0 or 1")
+        elif key == "wait_cap_s":
+            if value <= 0:
+                raise SystemExit("web.wait_cap_s must be > 0")
+        elif not isinstance(value, int) or value < 1:
+            raise SystemExit(f"web.{key} must be an integer >= 1")
     replication = manifest.setdefault("replication", {})
     for key in replication:
         if key not in _REPLICATION_KNOBS:
@@ -343,6 +369,17 @@ _PROFILING_KNOBS = {
     "prof_window_s": "LO_PROF_WINDOW_S",
 }
 
+# manifest web.<knob> -> the env var every machine receives
+# (docs/web.md). Cluster-wide like the serving knobs: a failover
+# promotion must not flip a machine between the event-loop core and
+# the threaded escape hatch, or change how many waiters it can hold.
+_WEB_KNOBS = {
+    "async": "LO_WEB_ASYNC",
+    "handlers": "LO_WEB_HANDLERS",
+    "max_conns": "LO_WEB_MAX_CONNS",
+    "wait_cap_s": "LO_WEB_WAIT_CAP_S",
+}
+
 # manifest replication.<knob> (docs/replication.md); the head machine
 # runs the whole store plane, every machine's LO_STORE_URL names the
 # primary AND the follower for client-side failover
@@ -407,6 +444,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _PROFILING_KNOBS.items():
         if knob in manifest.get("profiling", {}):
             shared[env_var] = str(manifest["profiling"][knob])
+    for knob, env_var in _WEB_KNOBS.items():
+        if knob in manifest.get("web", {}):
+            shared[env_var] = str(manifest["web"][knob])
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 

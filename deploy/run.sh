@@ -58,6 +58,18 @@
 #                            get HTTP 429 + Retry-After    (default 256)
 #   LO_SERVE_TIMEOUT_S       per-request wait bound → 503  (default 30)
 #
+# Web-serving knobs (docs/web.md has the full table):
+#   LO_WEB_ASYNC          1 = selectors event-loop serving core (idle
+#                         keep-alive/long-poll connections cost no
+#                         thread); 0 = threaded werkzeug escape hatch
+#   LO_WEB_HANDLERS       handler-pool width: blocking route functions
+#                         in flight at once (default 8, strictly
+#                         integral >= 1)
+#   LO_WEB_MAX_CONNS      open-connection cap; past it new connections
+#                         get 503 + close          (default 10000)
+#   LO_WEB_WAIT_CAP_S     ceiling on a /wait long-poll's requested
+#                         timeout                  (default 60, > 0)
+#
 # Profiling knobs (docs/profiling.md has the full table):
 #   LO_PROF_HZ            sampling-profiler rate for GET /debug/profile
 #                         (default 47; 0 disables the endpoint — the
@@ -112,6 +124,12 @@ serve_config.validate_all()
 # profiling knobs: HZ >= 0 (0 = /debug/profile disabled), window > 0
 from learningorchestra_tpu.telemetry import profile as lo_profile
 lo_profile.validate_env()
+# web-serving knobs: LO_WEB_ASYNC strictly 0/1, handler-pool width and
+# connection cap strictly integral >= 1, wait-timeout cap > 0 — a
+# typo'd LO_WEB_HANDLERS must refuse bring-up, never silently serve
+# one-wide
+from learningorchestra_tpu.utils import webloop
+webloop.validate_env()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
              "LO_STORE_SYNC_REPL", "LO_WIRE_V2"):
     value = os.environ.get(knob, "").strip()

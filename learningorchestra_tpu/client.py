@@ -4,7 +4,10 @@ Drop-in compatibility contract (reference:
 learning_orchestra_client/learning_orchestra_client/__init__.py:1-370):
 the class names (including the reference's ``AsyncronousWait`` spelling),
 method signatures, hard-coded service ports, poll-until-``finished``
-synchronization, ``ResponseTreat`` semantics (pretty JSON string by
+synchronization (now push-first: ``AsyncronousWait`` prefers the
+server's ``GET /jobs/<name>/wait`` long-poll when ``/health``
+advertises it, falling back to jittered metadata polling — docs/web.md),
+``ResponseTreat`` semantics (pretty JSON string by
 default, raise on 4xx, raw text on 5xx), **and the printed banner lines**
 — output parity is intended, so the banner texts below reproduce the
 reference's exact strings, typos included (``READE``, ``HTTP_SUCESS``).
@@ -19,8 +22,11 @@ from __future__ import annotations
 
 import json
 import time
+import urllib.parse
 
 import requests
+
+from learningorchestra_tpu.sched import policy as _policy
 
 cluster_url = None
 
@@ -53,19 +59,126 @@ class ResponseTreat:
 
 
 class AsyncronousWait:
+    """Reference-parity synchronization, now push-first.
+
+    The reference polls the dataset's ``finished`` flag every 3 seconds.
+    This client keeps that contract as the FALLBACK but prefers the
+    server's push route when available:
+
+    1. probe ``GET :5000/health`` once per cluster (cached) — a server
+       answering ``job_wait: true`` serves ``GET /jobs/<name>/wait``;
+    2. long-poll ``/wait``: one parked request per job, notified within
+       milliseconds of the job's done event instead of up to one poll
+       period late (404 → the job isn't tracked there, fall back);
+    3. metadata polling fallback: the fixed 3 s sleep becomes
+       exponential backoff with deterministic seeded jitter
+       (sched/policy.backoff_delay) so a restarting fleet doesn't poll
+       in lockstep, and ``Retry-After`` on 429/503 is honored.
+    """
+
     WAIT_TIME = 3
     METADATA_INDEX = 0
+    # poll-backoff ceiling: 4x the reference's pace, reached by the
+    # third fallback poll
+    MAX_WAIT_TIME = 12
+    # one probe per cluster base URL per process, not per wait() call
+    _push_probe_cache: dict = {}
 
     def wait(self, filename: str, pretty_response: bool = True) -> None:
         if pretty_response:
             _banner(" WAITING " + filename + " FINISH ")
         reader = DatabaseApi()
+        if self._push_supported(reader) and self._wait_push(reader, filename):
+            return
+        self._wait_poll(reader, filename)
+
+    def _service_base(self, reader) -> str:
+        # ".../files" → the service root serving /health and /jobs
+        return reader.url_base.rsplit("/", 1)[0]
+
+    def _push_supported(self, reader) -> bool:
+        base = self._service_base(reader)
+        cached = self._push_probe_cache.get(base)
+        if cached is not None:
+            return cached
+        try:
+            response = requests.get(base + "/health", timeout=2)
+            supported = bool(
+                response.status_code == 200
+                and response.json().get("job_wait")
+            )
+        except (requests.RequestException, ValueError):
+            supported = False
+        self._push_probe_cache[base] = supported
+        return supported
+
+    def _wait_push(self, reader, filename: str) -> bool:
+        """Long-poll ``GET /jobs/<filename>/wait`` until the tracking
+        job goes terminal. Returns False to fall back to metadata
+        polling (job unknown here, or the push route went away)."""
+        base = self._service_base(reader)
+        url = f"{base}/jobs/{urllib.parse.quote(filename, safe='')}/wait"
         while True:
-            time.sleep(self.WAIT_TIME)
-            listing = reader.read_file(filename, limit=1, pretty_response=False)
-            rows = listing["result"]
+            try:
+                response = requests.get(
+                    url, params={"timeout": "25"}, timeout=40
+                )
+            except requests.RequestException:
+                return False
+            if response.status_code in (429, 503):
+                self._sleep_retry_after(response)
+                continue
+            if response.status_code != 200:
+                return False  # 404: not tracked here — poll metadata
+            try:
+                result = response.json().get("result")
+            except ValueError:
+                return False
+            if isinstance(result, dict) and result.get("state") in (
+                "finished",
+                "failed",
+                "cancelled",
+            ):
+                # terminal states flip the dataset's finished flag
+                # before the done event fires (core/jobs._finalize), so
+                # returning here preserves the reference contract
+                return True
+            # {"result": "timeout"}: the job is alive — ask again
+
+    def _wait_poll(self, reader, filename: str) -> None:
+        """Metadata polling with seeded-jitter backoff — the hardened
+        version of the reference's fixed 3 s loop."""
+        attempt = 0
+        while True:
+            attempt += 1
+            time.sleep(
+                _policy.backoff_delay(
+                    filename,
+                    attempt,
+                    base_s=self.WAIT_TIME,
+                    cap_s=self.MAX_WAIT_TIME,
+                )
+            )
+            response = requests.get(
+                url=reader._url(filename),
+                params={"skip": "0", "limit": "1", "query": "{}"},
+            )
+            if response.status_code in (429, 503):
+                self._sleep_retry_after(response)
+                continue
+            listing = ResponseTreat().treatment(response, False)
+            rows = listing["result"] if isinstance(listing, dict) else None
             if rows and rows[self.METADATA_INDEX]["finished"]:
                 return
+
+    def _sleep_retry_after(self, response) -> None:
+        try:
+            delay = float(
+                response.headers.get("Retry-After", "") or self.WAIT_TIME
+            )
+        except ValueError:
+            delay = float(self.WAIT_TIME)
+        time.sleep(min(max(delay, 0.1), 60.0))
 
 
 class _RestClient:

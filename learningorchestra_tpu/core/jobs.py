@@ -92,6 +92,10 @@ class JobRecord:
     # directly) skips the journal: 3+ store writes per request with
     # zero recovery value would grow __lo_jobs__ for nothing.
     journaled: bool = field(default=True, repr=False)
+    # the dataset this job materialises (the filename clients know).
+    # GET /jobs/<name>/wait resolves a bare filename through this, so
+    # a client that only knows "titanic" finds "ingest:titanic".
+    collection: Optional[str] = None
 
     @property
     def correlation_id(self) -> Optional[str]:
@@ -109,6 +113,7 @@ class JobRecord:
             "priority": self.priority,
             "attempts": self.attempts,
             "correlation_id": self.correlation_id,
+            "collection": self.collection,
         }
 
     def trace_dict(self) -> dict:
@@ -137,6 +142,10 @@ class JobManager:
         self._lock = threading.Lock()
         self._events: dict[str, threading.Event] = {}
         self._tasks: dict[str, Task] = {}
+        # push-notification hooks: GET /jobs/<name>/wait parks a waiter
+        # and registers its notify here; _finalize fires them after the
+        # record goes terminal (utils/webloop.Waiter)
+        self._done_callbacks: dict[str, list[Callable[[], None]]] = {}
         self._max_history = _config.job_history()
         self._ttl_s = _config.job_ttl_s()
         self._retry_budget = _config.retry_budget()
@@ -243,6 +252,7 @@ class JobManager:
             job_class=job_class,
             priority=priority,
             journaled=journaled,
+            collection=collection,
             trace=_tracing.Trace(
                 # a job submitted from a REST handler inherits the
                 # request's correlation ID; elsewhere a fresh one
@@ -303,6 +313,8 @@ class JobManager:
                     del self._jobs[name]
                     self._events.pop(name, None)
                     self._tasks.pop(name, None)
+                    # waiters that raced in hit their timeout and re-poll
+                    self._done_callbacks.pop(name, None)
             raise
         return record, done
 
@@ -388,6 +400,7 @@ class JobManager:
             del self._jobs[name]
             self._events.pop(name, None)
             self._tasks.pop(name, None)
+            self._done_callbacks.pop(name, None)
 
     def _journal_event(self, record: JobRecord, event: str, **fields) -> None:
         journal = self._scheduler.journal
@@ -560,6 +573,20 @@ class JobManager:
             # waiters MUST wake no matter what failed above — a hung
             # done event is this subsystem's cardinal sin
             done.set()
+            # Push hooks fire AFTER the terminal state is visible. Pop
+            # under the lock: add_done_callback also holds it, so a
+            # registration either lands before this pop (fired here) or
+            # observes the terminal state and fires immediately — no
+            # callback is ever lost. A same-name successor registered
+            # after this record went terminal can at worst receive a
+            # spurious notify; waiters re-poll and re-park on those.
+            with self._lock:
+                callbacks = self._done_callbacks.pop(record.name, [])
+            for callback in callbacks:
+                try:
+                    callback()
+                except Exception:  # noqa: BLE001 — a waiter's bug
+                    traceback.print_exc()  # must not mask others' wake
 
     def cancel(self, name: str) -> str:
         """Request cancellation: ``"unknown"`` (→404), ``"terminal"``
@@ -576,6 +603,44 @@ class JobManager:
         if task is not None:
             task.token.cancel(f"job {name!r} cancelled by request")
         return "cancelling"
+
+    def add_done_callback(self, name: str, callback: Callable[[], None]) -> str:
+        """Register ``callback`` to fire once job ``name`` reaches a
+        terminal state — the push half of ``GET /jobs/<name>/wait``.
+        Returns ``"unknown"`` (no such job), ``"terminal"`` (already
+        done — the callback fired before returning), or
+        ``"registered"``. Callbacks must be cheap and thread-safe:
+        they run on the finalizing scheduler worker."""
+        with self._lock:
+            record = self._jobs.get(name)
+            if record is None:
+                return "unknown"
+            if record.state in TERMINAL_STATES:
+                fire_now = True
+            else:
+                self._done_callbacks.setdefault(name, []).append(callback)
+                fire_now = False
+        if fire_now:
+            callback()
+            return "terminal"
+        return "registered"
+
+    def resolve_wait(self, name: str) -> Optional[JobRecord]:
+        """The record ``GET /jobs/<name>/wait`` should watch: an exact
+        job-name match first, else the newest job materialising ``name``
+        as its collection — clients know dataset filenames ("titanic"),
+        while jobs carry prefixed names ("ingest:titanic")."""
+        with self._lock:
+            record = self._jobs.get(name)
+            if record is not None:
+                return record
+            best: Optional[JobRecord] = None
+            for candidate in self._jobs.values():
+                if candidate.collection != name:
+                    continue
+                if best is None or candidate.submitted_at >= best.submitted_at:
+                    best = candidate
+            return best
 
     def get(self, name: str) -> Optional[JobRecord]:
         with self._lock:

@@ -133,7 +133,12 @@ from learningorchestra_tpu.core.wire import (
     decode_frame,
     encode_frame,
 )
-from learningorchestra_tpu.utils.web import Response, ServerThread, WebApp
+from learningorchestra_tpu.utils.web import (
+    Response,
+    ServerThread,
+    Waiter,
+    WebApp,
+)
 
 DEFAULT_STORE_PORT = 27027
 
@@ -335,32 +340,53 @@ def create_store_app(
             feed = store.wal_feed(epoch, offset, limit=limit)
         except (AttributeError, ValueError):
             return {"error": "replication not enabled (LO_REPLICATE=1)"}, 404
-        if wait_s > 0 and not feed["records"] and not feed["resync"]:
-            # LONG-POLL: a caught-up follower parks here until a record
-            # lands (or the wait expires) instead of sleeping its poll
-            # interval — this is what keeps sync-repl ack latency at
-            # ~tens of milliseconds rather than one poll period per
-            # acknowledged mutation. Old followers that send no `wait`
-            # keep the plain immediate-answer behavior.
-            import time
 
-            wait_deadline = time.monotonic() + min(wait_s, 30.0)
-            while time.monotonic() < wait_deadline:
-                time.sleep(0.05)
+        def ship_ack(resync: bool) -> None:
+            # Sync-repl ack ledger: a follower requests from its APPLIED
+            # position, so this request's (epoch, offset) is what a
+            # replica durably holds — wake writers in _await_replicated.
+            cv = role.get("repl_cv")
+            if cv is not None and not resync:
+                with cv:
+                    if (epoch, offset) > tuple(role.get("shipped", (-1, -1))):
+                        role["shipped"] = (epoch, offset)
+                        cv.notify_all()
+
+        if wait_s > 0 and not feed["records"] and not feed["resync"]:
+            # LONG-POLL on the shared waiter machinery (utils/webloop):
+            # a caught-up follower parks here until a record lands or
+            # the wait expires — this is what keeps sync-repl ack
+            # latency at ~tens of milliseconds rather than one poll
+            # period per acknowledged mutation. Under the event-loop
+            # server the CONNECTION parks (no thread per waiting
+            # replica); the threaded escape hatch blocks the request
+            # thread as before. The ack ledger updates before parking:
+            # it reflects the request's applied position, not the
+            # response. Old followers that send no `wait` keep the
+            # plain immediate-answer behavior.
+            ship_ack(False)
+
+            def wal_ready():
                 current_epoch, current_length = store.wal_position
                 if current_epoch != epoch or current_length > offset:
-                    feed = store.wal_feed(epoch, offset, limit=limit)
-                    break
+                    fresh = store.wal_feed(epoch, offset, limit=limit)
+                    fresh["term"] = role.get("term", 0)
+                    return fresh, 200
+                return None
+
+            def wal_timeout():
+                stale = dict(feed)
+                stale["term"] = role.get("term", 0)
+                return stale, 200
+
+            return Waiter(
+                wal_ready,
+                min(wait_s, 30.0),
+                wal_timeout,
+                interval_s=0.05,  # the WAL has no push hook; re-poll
+            )
         feed["term"] = role.get("term", 0)  # followers track it for takeover
-        # Sync-repl ack ledger: a follower requests from its APPLIED
-        # position, so this request's (epoch, offset) is what a replica
-        # durably holds — wake writers waiting in _await_replicated.
-        cv = role.get("repl_cv")
-        if cv is not None and not feed["resync"]:
-            with cv:
-                if (epoch, offset) > tuple(role.get("shipped", (-1, -1))):
-                    role["shipped"] = (epoch, offset)
-                    cv.notify_all()
+        ship_ack(bool(feed["resync"]))
         return feed, 200
 
     @app.route("/compact", methods=("POST",))

@@ -381,6 +381,14 @@ def main() -> None:
         flush=True,
     )
 
+    # ...and the web-serving knobs (docs/web.md): LO_WEB_ASYNC=0 is the
+    # threaded escape hatch — an operator should see at boot which
+    # serving core is live, and a typo'd LO_WEB_HANDLERS must refuse
+    # bring-up, never silently serve at the default width
+    from learningorchestra_tpu.utils import webloop
+
+    print(f"web config: {webloop.validate_env()}", flush=True)
+
     data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache
 

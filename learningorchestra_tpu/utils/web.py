@@ -23,6 +23,8 @@ from werkzeug.wrappers import Request, Response
 
 from learningorchestra_tpu.telemetry import metrics as _metrics
 from learningorchestra_tpu.telemetry import tracing as _tracing
+from learningorchestra_tpu.utils import webloop as _webloop
+from learningorchestra_tpu.utils.webloop import Waiter  # noqa: F401 — re-export
 
 
 def jsonify(payload: Any) -> Response:
@@ -186,13 +188,30 @@ class WebApp:
 
         - ``GET /jobs`` — every tracked job's state, class, priority,
           attempt count, timings, error, and correlation ID;
+        - ``GET /jobs/<name>`` — one tracked job's record (404 unknown);
+        - ``GET /jobs/<name>/wait?timeout=S`` — push job completion:
+          long-poll (or SSE with ``Accept: text/event-stream``) until
+          the job goes terminal, released by the job's ``done`` event —
+          no client-side 3-second polling. Immediate return for
+          already-terminal jobs; a bare dataset filename resolves to
+          the newest job materialising it (``titanic`` →
+          ``ingest:titanic``); 404 parity with ``GET /jobs/<name>``;
+          a timeout answers a clean ``{"result": "timeout"}`` re-poll
+          hint (docs/web.md);
         - ``GET /jobs/<name>/trace`` — its correlated span tree;
+        - ``GET /health`` — liveness + feature probe: ``job_wait: true``
+          tells clients the push route exists (client.py prefers it
+          over metadata polling);
         - ``DELETE /jobs/<name>`` — cooperative cancellation: a queued
           job terminates without running, a running one at its next
           cancel check (ml/builder.py's phase loop checks); 202 while
           the cancel propagates, 409 once the job is already terminal.
+          A cancel also wakes the job's parked waiters.
         """
         self.register_job_traces(jobs)
+        # terminal-state names live with the manager; imported here (not
+        # at module top) to keep this transport module import-light
+        from learningorchestra_tpu.core.jobs import TERMINAL_STATES
 
         @self.route("/jobs")
         def read_jobs(request):
@@ -206,6 +225,60 @@ class WebApp:
             if outcome == "terminal":
                 return {"result": "already_terminal"}, 409
             return {"result": "cancelling"}, 202
+
+        @self.route("/jobs/<job_name>", methods=("GET",))
+        def read_job(request, job_name):
+            record = jobs.get(job_name)
+            if record is None:
+                return {"result": "not_found"}, 404
+            return {"result": record.as_dict()}, 200
+
+        @self.route("/jobs/<job_name>/wait", methods=("GET",))
+        def wait_job(request, job_name):
+            try:
+                timeout_s = float(request.args.get("timeout", "25"))
+            except ValueError:
+                return {"result": "bad_timeout"}, 400
+            if timeout_s != timeout_s or timeout_s < 0:  # NaN included
+                return {"result": "bad_timeout"}, 400
+            timeout_s = min(timeout_s, _webloop.wait_cap_s())
+            record = jobs.resolve_wait(job_name)
+            if record is None:
+                # parity with GET /jobs/<name>: unknown job is a 404,
+                # clients fall back to metadata polling
+                return {"result": "not_found"}, 404
+            sse = "text/event-stream" in (request.headers.get("Accept") or "")
+
+            def poll(_record=record):
+                if _record.state in TERMINAL_STATES:
+                    return {"result": _record.as_dict()}, 200
+                return None
+
+            def on_timeout(_record=record):
+                # a clean re-poll hint: the job is alive, ask again
+                return {
+                    "result": "timeout",
+                    "job": _record.name,
+                    "state": _record.state,
+                }, 200
+
+            waiter = Waiter(poll, timeout_s, on_timeout, sse=sse)
+            jobs.add_done_callback(record.name, waiter.notify)
+            return waiter
+
+        if not any(
+            rule.rule == "/health" for rule in self.url_map.iter_rules()
+        ):
+
+            @self.route("/health")
+            def health(request):
+                return {
+                    "result": "ok",
+                    "service": self.name,
+                    # feature probe: client.py checks this once per
+                    # cluster before preferring /wait over polling
+                    "job_wait": True,
+                }, 200
 
     def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
         def decorator(handler: Callable) -> Callable:
@@ -238,6 +311,10 @@ class WebApp:
             # e.g. BadRequest from request.get_json() on a malformed
             # body — keep its real status code, don't convert to a 500.
             return error.get_response(request.environ)
+        if isinstance(result, Waiter):
+            # the answer isn't ready: __call__ parks it (event loop) or
+            # blocks on it (threaded server / test client)
+            return result
         if isinstance(result, Response):
             return result
         if isinstance(result, tuple):
@@ -283,11 +360,38 @@ class WebApp:
         finally:
             self._in_flight.labels(self.name).dec()
         route = environ.get("lo.route", "<unmatched>")
+        method = request.method
+        if isinstance(response, Waiter):
+            waiter = response
+            waiter.correlation_id = correlation_id
+            if environ.get("lo.async"):
+                # Event-loop server: park the CONNECTION, not a thread.
+                # Metrics record at resolution — a long-poll's latency
+                # IS its parked time.
+                def complete(status, _route=route, _method=method):
+                    self._requests_total.labels(
+                        self.name, _route, _method, status
+                    ).inc()
+                    self._request_seconds.labels(
+                        self.name, _route, _method
+                    ).observe(time.perf_counter() - started)
+
+                waiter.on_complete = complete
+                environ["lo.waiter"] = waiter
+                start_response("204 No Content", [])
+                return [b""]
+            # Threaded server / test client: reference-parity blocking —
+            # this request thread parks until ready or timeout.
+            result, kind = waiter.resolve_blocking()
+            body, status, content_type = _webloop.waiter_body(
+                waiter, result, kind
+            )
+            response = Response(body, status=status, mimetype=content_type)
         self._requests_total.labels(
-            self.name, route, request.method, response.status_code
+            self.name, route, method, response.status_code
         ).inc()
         self._request_seconds.labels(
-            self.name, route, request.method
+            self.name, route, method
         ).observe(time.perf_counter() - started)
         response.headers[_tracing.CORRELATION_HEADER] = correlation_id
         return response(environ, start_response)
@@ -297,25 +401,49 @@ class WebApp:
 
 
 class ServerThread:
-    """Run a WSGI app on a background thread (integration tests, dev)."""
+    """Run a WSGI app on a background thread (integration tests, dev).
+
+    ``LO_WEB_ASYNC=1`` (the default) serves through the event-loop core
+    (utils/webloop.LoopServer): one selectors loop owns every socket and
+    a bounded handler pool runs the route functions. ``LO_WEB_ASYNC=0``
+    is the escape hatch back to werkzeug's thread-per-request server —
+    byte-compatible routes, reference-parity blocking waits."""
 
     def __init__(self, app: WebApp, host: str, port: int):
-        self._server = make_server(host, port, app, threaded=True)
         self.host = host
-        self.port = self._server.server_port
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name=f"{app.name}-server"
-        )
+        if _webloop.web_async_enabled():
+            self._server = None
+            self._loop = _webloop.LoopServer(app, host, port)
+            self.port = self._loop.port
+            self._thread = self._loop._thread
+        else:
+            self._loop = None
+            self._server = make_server(host, port, app, threaded=True)
+            self.port = self._server.server_port
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                daemon=True,
+                name=f"{app.name}-server",
+            )
 
     def start(self) -> "ServerThread":
-        self._thread.start()
+        if self._loop is not None:
+            self._loop.start()
+        else:
+            self._thread.start()
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._loop is not None:
+            self._loop.stop()
+        else:
+            self._server.shutdown()
         self._thread.join(timeout=5)
 
 
 def run_app(app: WebApp, host: str, port: int) -> None:
     """Serve forever in the foreground (container entrypoint)."""
+    if _webloop.web_async_enabled():
+        _webloop.LoopServer(app, host, port).serve_forever()
+        return
     make_server(host, port, app, threaded=True).serve_forever()

@@ -679,6 +679,53 @@ class TestProfilingManifest:
             load({"prof_window_s": 0})
 
 
+class TestWebManifest:
+    def test_web_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["web"] = {
+            "async": 1,
+            "handlers": 8,
+            "max_conns": 10000,
+            "wait_cap_s": 60,
+        }
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # every member serves HTTP — all get the knobs
+            env = plan["env"]
+            assert env["LO_WEB_ASYNC"] == "1"
+            assert env["LO_WEB_HANDLERS"] == "8"
+            assert env["LO_WEB_MAX_CONNS"] == "10000"
+            assert env["LO_WEB_WAIT_CAP_S"] == "60"
+
+    def test_web_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(web):
+            manifest = _manifest()
+            manifest["web"] = web
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # async 0 = threaded escape hatch: valid; fractional cap: valid
+        loaded = load({"async": 0, "wait_cap_s": 0.5})
+        assert loaded["web"]["async"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"async": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"async": 2})
+        with pytest.raises(SystemExit):
+            load({"handlers": 0})
+        with pytest.raises(SystemExit):
+            load({"handlers": 9.5})  # widths are integers
+        with pytest.raises(SystemExit):
+            load({"wait_cap_s": 0})
+
+
 class TestMetricsScrape:
     def test_parse_prometheus_sums_families(self):
         cluster = _load_cluster_module()

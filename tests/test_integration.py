@@ -45,9 +45,11 @@ def stack(tmp_path_factory):
         cls, attr = PORT_ATTRS[server.canonical_port]
         saved[(cls, attr)] = getattr(cls, attr)
         setattr(cls, attr, str(server.port))
+    saved_wait = lo_client.AsyncronousWait.WAIT_TIME
     lo_client.AsyncronousWait.WAIT_TIME = 0.05  # fast polls in tests
     Context("127.0.0.1")
     yield store
+    lo_client.AsyncronousWait.WAIT_TIME = saved_wait
     for (cls, attr), value in saved.items():
         setattr(cls, attr, value)
     for server in servers:

@@ -58,6 +58,11 @@ def test_pip_install_provides_reference_client_surface(tmp_path):
         "from learningorchestra_tpu.core.wire import MAGIC_V2\n"
         "from learningorchestra_tpu.utils.dtypepolicy import dtype_policy\n"
         "assert dtype_policy() in ('f32', 'bf16')\n"
+        # the event-loop serving core ships installed (stdlib selectors
+        # only — the bare install can load it without jax/werkzeug)
+        "import learningorchestra_tpu.utils.webloop as webloop\n"
+        "assert callable(webloop.validate_env)\n"
+        "assert webloop.Waiter and webloop.LoopServer\n"
         "print('client surface ok')\n"
     )
     env = dict(os.environ)

@@ -1,0 +1,597 @@
+"""Event-loop serving core (utils/webloop) + push job completion.
+
+Covers the /wait route contract (immediate return, timeout hint, 404
+parity, cancel wake, SSE framing golden), the raw-socket behaviours of
+the loop server (keep-alive pipelining, slow-loris eviction, graceful
+drain, connection cap, O(1) threads under many waiters), the
+LO_WEB_ASYNC=0 escape hatch's byte parity, the web knobs' fail-fast
+validation, and the client's push-first waiting (docs/web.md).
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from learningorchestra_tpu import client as client_module
+from learningorchestra_tpu.core.jobs import JobManager
+from learningorchestra_tpu.sched import policy
+from learningorchestra_tpu.utils import webloop
+from learningorchestra_tpu.utils.web import ServerThread, WebApp
+
+
+def body(response):
+    return json.loads(response.get_data())
+
+
+def make_app(jobs=None):
+    jobs = jobs or JobManager()
+    app = WebApp("waitsvc")
+    app.register_job_routes(jobs)
+    return app, jobs
+
+
+def _quick():
+    return "done"
+
+
+def _blocked(release):
+    release.wait(30)
+    return "released"
+
+
+def _cancellable(started):
+    from learningorchestra_tpu.sched.cancel import check_cancelled
+
+    started.set()
+    while True:
+        check_cancelled()
+        time.sleep(0.005)
+
+
+def _wait_state(record, state, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if record.state == state:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"job never reached {state!r} (at {record.state!r})")
+
+
+def _read_response(sock, buf=b""):
+    """One HTTP response off a blocking socket: ``(head, body,
+    leftover)`` — leftover carries pipelined bytes for the next call."""
+    while b"\r\n\r\n" not in buf:
+        data = sock.recv(65536)
+        if not data:
+            raise AssertionError("connection closed before headers")
+        buf += data
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n")[1:]:
+        key, _, value = line.partition(b":")
+        if key.strip().lower() == b"content-length":
+            length = int(value.strip())
+    while len(rest) < length:
+        data = sock.recv(65536)
+        if not data:
+            raise AssertionError("connection closed before body")
+        rest += data
+    return head, rest[:length], rest[length:]
+
+
+def _read_until_close(sock):
+    chunks = []
+    while True:
+        data = sock.recv(65536)
+        if not data:
+            break
+        chunks.append(data)
+    return b"".join(chunks)
+
+
+@pytest.fixture()
+def loop_app():
+    app, jobs = make_app()
+    server = webloop.LoopServer(app, "127.0.0.1", 0).start()
+    yield app, jobs, server
+    server.stop()
+
+
+class TestWaitRoute:
+    """The /jobs/<name>/wait contract via the blocking (test-client)
+    resolution path — shared handler code with the event loop."""
+
+    def test_already_finished_immediate(self):
+        app, jobs = make_app()
+        jobs.submit("quick", _quick)
+        jobs.wait("quick", timeout=10)
+        client = app.test_client()
+        start = time.perf_counter()
+        response = client.get("/jobs/quick/wait?timeout=20")
+        elapsed = time.perf_counter() - start
+        assert response.status_code == 200
+        assert body(response)["result"]["state"] == "finished"
+        assert elapsed < 2.0  # immediate, not the requested 20 s
+
+    def test_timeout_is_a_clean_repoll_hint(self):
+        app, jobs = make_app()
+        release = threading.Event()
+        jobs.submit("parked", _blocked, release)
+        try:
+            response = app.test_client().get("/jobs/parked/wait?timeout=0.05")
+            assert response.status_code == 200
+            payload = body(response)
+            assert payload["result"] == "timeout"
+            assert payload["job"] == "parked"
+            assert payload["state"] in ("pending", "running")
+        finally:
+            release.set()
+
+    def test_bad_timeout_400(self):
+        app, jobs = make_app()
+        client = app.test_client()
+        for bad in ("abc", "-1", "nan"):
+            response = client.get(f"/jobs/x/wait?timeout={bad}")
+            assert response.status_code == 400
+            assert body(response) == {"result": "bad_timeout"}
+
+    def test_404_parity_with_job_read(self):
+        app, jobs = make_app()
+        client = app.test_client()
+        plain = client.get("/jobs/nope")
+        wait = client.get("/jobs/nope/wait?timeout=1")
+        assert plain.status_code == wait.status_code == 404
+        assert body(plain) == body(wait) == {"result": "not_found"}
+
+    def test_bare_filename_resolves_to_collection_job(self):
+        """Clients know dataset filenames; jobs carry prefixed names."""
+        app, jobs = make_app()
+        jobs.submit("ingest:titanic", _quick, collection="titanic")
+        jobs.wait("ingest:titanic", timeout=10)
+        response = app.test_client().get("/jobs/titanic/wait?timeout=5")
+        assert response.status_code == 200
+        assert body(response)["result"]["name"] == "ingest:titanic"
+
+    def test_health_advertises_job_wait(self):
+        app, jobs = make_app()
+        response = app.test_client().get("/health")
+        assert response.status_code == 200
+        assert body(response)["job_wait"] is True
+
+    def test_cancel_wakes_waiters(self):
+        app, jobs = make_app()
+        started = threading.Event()
+        jobs.submit("doomed", _cancellable, started)
+        assert started.wait(10)
+        results = []
+
+        def waiter():
+            results.append(
+                body(app.test_client().get("/jobs/doomed/wait?timeout=15"))
+            )
+
+        thread = threading.Thread(target=waiter)
+        thread.start()
+        time.sleep(0.3)  # the waiter is blocked in resolve_blocking
+        start = time.perf_counter()
+        cancel = app.test_client().delete("/jobs/doomed")
+        assert cancel.status_code == 202
+        thread.join(timeout=10)
+        assert not thread.is_alive()
+        assert results[0]["result"]["state"] == "cancelled"
+        assert time.perf_counter() - start < 8.0  # woke, did not ride out 15 s
+
+
+class TestSSE:
+    def test_timeout_frame_golden(self):
+        """The exact bytes an SSE /wait answers on timeout."""
+        app, jobs = make_app()
+        release = threading.Event()
+        jobs.submit("sse-parked", _blocked, release)
+        _wait_state(jobs.get("sse-parked"), "running")
+        try:
+            response = app.test_client().get(
+                "/jobs/sse-parked/wait?timeout=0.05",
+                headers={"Accept": "text/event-stream"},
+            )
+            assert response.status_code == 200
+            assert response.content_type.startswith("text/event-stream")
+            expected = webloop.SSE_PREAMBLE + webloop.sse_frame(
+                "timeout",
+                {"result": "timeout", "job": "sse-parked", "state": "running"},
+            )
+            assert response.get_data() == expected
+        finally:
+            release.set()
+
+    def test_async_and_threaded_framing_byte_identical(self, loop_app):
+        """The golden parity claim: the event loop's SSE stream (head at
+        park, frame at resolve) concatenates to the same bytes the
+        blocking server answers in one body."""
+        app, jobs, server = loop_app
+        release = threading.Event()
+        jobs.submit("sse-parity", _blocked, release)
+        _wait_state(jobs.get("sse-parity"), "running")
+        try:
+            threaded_body = app.test_client().get(
+                "/jobs/sse-parity/wait?timeout=0.2",
+                headers={"Accept": "text/event-stream"},
+            ).get_data()
+            sock = socket.create_connection(("127.0.0.1", server.port), 10)
+            sock.settimeout(10)
+            sock.sendall(
+                b"GET /jobs/sse-parity/wait?timeout=0.2 HTTP/1.1\r\n"
+                b"Host: t\r\nAccept: text/event-stream\r\n\r\n"
+            )
+            raw = _read_until_close(sock)
+            sock.close()
+            head, _, stream = raw.partition(b"\r\n\r\n")
+            assert b"200 OK" in head.split(b"\r\n", 1)[0]
+            assert b"text/event-stream" in head
+            assert stream == threaded_body
+        finally:
+            release.set()
+
+    def test_done_event_on_finished_job(self, loop_app):
+        app, jobs, server = loop_app
+        jobs.submit("sse-done", _quick)
+        jobs.wait("sse-done", timeout=10)
+        sock = socket.create_connection(("127.0.0.1", server.port), 10)
+        sock.settimeout(10)
+        sock.sendall(
+            b"GET /jobs/sse-done/wait?timeout=5 HTTP/1.1\r\n"
+            b"Host: t\r\nAccept: text/event-stream\r\n\r\n"
+        )
+        raw = _read_until_close(sock)
+        sock.close()
+        _, _, stream = raw.partition(b"\r\n\r\n")
+        assert stream.startswith(webloop.SSE_PREAMBLE)
+        assert b"event: done\n" in stream
+        payload = json.loads(
+            stream.split(b"data: ", 1)[1].split(b"\n", 1)[0]
+        )
+        assert payload["result"]["state"] == "finished"
+
+
+class TestLoopServer:
+    def test_keep_alive_pipelining(self, loop_app):
+        """Two requests in ONE send, two responses on one connection."""
+        app, jobs, server = loop_app
+        request = b"GET /health HTTP/1.1\r\nHost: t\r\n\r\n"
+        sock = socket.create_connection(("127.0.0.1", server.port), 10)
+        sock.settimeout(10)
+        sock.sendall(request + request)
+        head1, body1, leftover = _read_response(sock)
+        head2, body2, _ = _read_response(sock, leftover)
+        sock.close()
+        for head, payload in ((head1, body1), (head2, body2)):
+            assert b"200 OK" in head.split(b"\r\n", 1)[0]
+            assert b"Connection: keep-alive" in head
+            assert json.loads(payload)["job_wait"] is True
+
+    def test_slow_loris_eviction(self):
+        app, jobs = make_app()
+        server = webloop.LoopServer(
+            app, "127.0.0.1", 0, header_timeout_s=0.3
+        ).start()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port), 10)
+            sock.settimeout(10)
+            sock.sendall(b"GET /health HTTP/1.1\r\nHost")  # never finishes
+            raw = _read_until_close(sock)  # 408, then server closes
+            sock.close()
+            assert b"408" in raw.split(b"\r\n", 1)[0]
+            assert json.loads(raw.partition(b"\r\n\r\n")[2]) == {
+                "result": "request_timeout"
+            }
+        finally:
+            server.stop()
+
+    def test_graceful_stop_drains_parked_waiters(self):
+        app, jobs = make_app()
+        server = webloop.LoopServer(app, "127.0.0.1", 0).start()
+        release = threading.Event()
+        jobs.submit("drainee", _blocked, release)
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port), 10)
+            sock.settimeout(10)
+            sock.sendall(
+                b"GET /jobs/drainee/wait?timeout=30 HTTP/1.1\r\nHost: t\r\n\r\n"
+            )
+            deadline = time.monotonic() + 10
+            while server.waiter_count < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.waiter_count == 1
+            server.stop()
+            head, payload, _ = _read_response(sock)
+            sock.close()
+            assert b"200 OK" in head.split(b"\r\n", 1)[0]
+            assert json.loads(payload)["result"] == "timeout"
+        finally:
+            release.set()
+
+    def test_many_waiters_o1_threads(self):
+        app, jobs = make_app()
+        server = webloop.LoopServer(app, "127.0.0.1", 0, handlers=4).start()
+        release = threading.Event()
+        jobs.submit("crowd", _blocked, release)
+        socks = []
+        try:
+            # warm the lazily-spawned handler pool so its threads do not
+            # count against the parked-waiter delta
+            sock = socket.create_connection(("127.0.0.1", server.port), 10)
+            sock.settimeout(10)
+            sock.sendall(b"GET /health HTTP/1.1\r\nHost: t\r\n\r\n")
+            _read_response(sock)
+            sock.close()
+            threads_before = threading.active_count()
+            for _ in range(30):
+                sock = socket.create_connection(
+                    ("127.0.0.1", server.port), 10
+                )
+                sock.settimeout(10)
+                sock.sendall(
+                    b"GET /jobs/crowd/wait?timeout=25 HTTP/1.1\r\n"
+                    b"Host: t\r\nConnection: close\r\n\r\n"
+                )
+                socks.append(sock)
+            deadline = time.monotonic() + 10
+            while server.waiter_count < 30 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.waiter_count == 30
+            # handler pool is capped at 4; parked connections hold none
+            assert threading.active_count() - threads_before <= 4
+            release.set()
+            for sock in socks:
+                head, payload, _ = _read_response(sock)
+                assert json.loads(payload)["result"]["state"] == "finished"
+        finally:
+            release.set()
+            for sock in socks:
+                sock.close()
+            server.stop()
+
+    def test_connection_cap_503(self):
+        app, jobs = make_app()
+        server = webloop.LoopServer(app, "127.0.0.1", 0, max_conns=1).start()
+        try:
+            keeper = socket.create_connection(("127.0.0.1", server.port), 10)
+            keeper.settimeout(10)
+            keeper.sendall(b"GET /health HTTP/1.1\r\nHost: t\r\n\r\n")
+            _read_response(keeper)  # keep-alive: still counted
+            extra = socket.create_connection(("127.0.0.1", server.port), 10)
+            extra.settimeout(10)
+            raw = _read_until_close(extra)
+            extra.close()
+            keeper.close()
+            assert b"503" in raw.split(b"\r\n", 1)[0]
+            assert b"Retry-After: 1" in raw
+        finally:
+            server.stop()
+
+    def test_metrics_families_visible(self, loop_app):
+        app, jobs, server = loop_app
+        release = threading.Event()
+        jobs.submit("gauged", _blocked, release)
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port), 10)
+            sock.settimeout(10)
+            sock.sendall(
+                b"GET /jobs/gauged/wait?timeout=20 HTTP/1.1\r\nHost: t\r\n\r\n"
+            )
+            deadline = time.monotonic() + 10
+            while server.waiter_count < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            text = app.test_client().get("/metrics").get_data(as_text=True)
+            assert 'lo_web_waiters{service="waitsvc"} 1' in text
+            assert 'lo_web_connections{service="waitsvc",state="idle"}' in text
+            assert (
+                'lo_web_connections{service="waitsvc",state="active"}' in text
+            )
+            assert "lo_web_notify_seconds" in text
+            release.set()
+            _read_response(sock)
+            sock.close()
+        finally:
+            release.set()
+
+
+class TestEscapeHatch:
+    def test_threaded_server_parity(self, monkeypatch):
+        """LO_WEB_ASYNC=0 serves the same /wait bytes through werkzeug's
+        thread-per-request server."""
+        import requests
+
+        app, jobs = make_app()
+        jobs.submit("parity", _quick)
+        jobs.wait("parity", timeout=10)
+        bodies = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("LO_WEB_ASYNC", flag)
+            server = ServerThread(app, "127.0.0.1", 0).start()
+            try:
+                assert (server._loop is None) == (flag == "0")
+                response = requests.get(
+                    f"http://127.0.0.1:{server.port}/jobs/parity/wait",
+                    params={"timeout": "5"},
+                    timeout=10,
+                )
+                assert response.status_code == 200
+                bodies[flag] = response.content
+                health = requests.get(
+                    f"http://127.0.0.1:{server.port}/health", timeout=10
+                )
+                assert health.json()["job_wait"] is True
+            finally:
+                server.stop()
+        assert bodies["0"] == bodies["1"]
+
+
+class TestKnobs:
+    def test_async_flag_strict(self, monkeypatch):
+        monkeypatch.setenv("LO_WEB_ASYNC", "2")
+        with pytest.raises(ValueError, match="LO_WEB_ASYNC"):
+            webloop.web_async_enabled()
+        monkeypatch.setenv("LO_WEB_ASYNC", "0")
+        assert webloop.web_async_enabled() is False
+        monkeypatch.delenv("LO_WEB_ASYNC")
+        assert webloop.web_async_enabled() is True
+
+    def test_handlers_strictly_integral(self, monkeypatch):
+        for bad in ("0", "2.0", "lots"):
+            monkeypatch.setenv("LO_WEB_HANDLERS", bad)
+            with pytest.raises(ValueError, match="LO_WEB_HANDLERS"):
+                webloop.handler_pool_size()
+        monkeypatch.setenv("LO_WEB_HANDLERS", "3")
+        assert webloop.handler_pool_size() == 3
+
+    def test_wait_cap_positive(self, monkeypatch):
+        monkeypatch.setenv("LO_WEB_WAIT_CAP_S", "0")
+        with pytest.raises(ValueError, match="LO_WEB_WAIT_CAP_S"):
+            webloop.wait_cap_s()
+
+    def test_validate_env_resolves_defaults(self, monkeypatch):
+        for knob in (
+            "LO_WEB_ASYNC", "LO_WEB_HANDLERS",
+            "LO_WEB_MAX_CONNS", "LO_WEB_WAIT_CAP_S",
+        ):
+            monkeypatch.delenv(knob, raising=False)
+        assert webloop.validate_env() == {
+            "LO_WEB_ASYNC": 1,
+            "LO_WEB_HANDLERS": 8,
+            "LO_WEB_MAX_CONNS": 10_000,
+            "LO_WEB_WAIT_CAP_S": 60.0,
+        }
+
+    def test_wait_timeout_capped_by_knob(self, monkeypatch):
+        monkeypatch.setenv("LO_WEB_WAIT_CAP_S", "0.05")
+        app, jobs = make_app()
+        release = threading.Event()
+        jobs.submit("capped", _blocked, release)
+        try:
+            start = time.perf_counter()
+            response = app.test_client().get("/jobs/capped/wait?timeout=30")
+            assert body(response)["result"] == "timeout"
+            assert time.perf_counter() - start < 5.0
+        finally:
+            release.set()
+
+
+class TestWaiterUnit:
+    def test_notify_idempotent_first_instant_wins(self):
+        waiter = webloop.Waiter(lambda: None, 1.0, lambda: ({}, 200))
+        waiter.notify()
+        first = waiter.notified_at
+        time.sleep(0.01)
+        waiter.notify()
+        assert waiter.notified_at == first
+
+    def test_resolve_blocking_kinds(self):
+        ready = webloop.Waiter(lambda: ({"ok": 1}, 200), 1.0, lambda: ({}, 200))
+        assert ready.resolve_blocking() == (({"ok": 1}, 200), "ready")
+        timed = webloop.Waiter(
+            lambda: None, 0.02, lambda: ({"late": 1}, 200)
+        )
+        assert timed.resolve_blocking() == (({"late": 1}, 200), "timeout")
+
+
+class TestClientPush:
+    @pytest.fixture()
+    def fresh_probe_cache(self, monkeypatch):
+        monkeypatch.setattr(
+            client_module.AsyncronousWait, "_push_probe_cache", {}
+        )
+
+    def test_wait_prefers_push(self, monkeypatch, fresh_probe_cache):
+        """With /health advertising job_wait, wait() resolves through
+        /jobs/<name>/wait — the app has NO metadata route, so a poll
+        fallback would fail loudly."""
+        app, jobs = make_app()
+        jobs.submit("ingest:titanic", _quick, collection="titanic")
+        jobs.wait("ingest:titanic", timeout=10)
+        server = webloop.LoopServer(app, "127.0.0.1", 0).start()
+        try:
+            monkeypatch.setattr(
+                client_module.DatabaseApi,
+                "DATABASE_API_PORT",
+                str(server.port),
+            )
+            client_module.Context("127.0.0.1")
+            start = time.perf_counter()
+            client_module.AsyncronousWait().wait(
+                "titanic", pretty_response=False
+            )
+            assert time.perf_counter() - start < 5.0
+        finally:
+            server.stop()
+
+    def test_push_404_falls_back_to_metadata_poll(
+        self, monkeypatch, fresh_probe_cache
+    ):
+        app, jobs = make_app()
+
+        @app.route("/files/<filename>")
+        def read_file(request, filename):
+            return {"result": [{"filename": filename, "finished": True}]}, 200
+
+        server = webloop.LoopServer(app, "127.0.0.1", 0).start()
+        try:
+            monkeypatch.setattr(
+                client_module.DatabaseApi,
+                "DATABASE_API_PORT",
+                str(server.port),
+            )
+            monkeypatch.setattr(
+                client_module.AsyncronousWait, "WAIT_TIME", 0.01
+            )
+            monkeypatch.setattr(
+                client_module.AsyncronousWait, "MAX_WAIT_TIME", 0.02
+            )
+            client_module.Context("127.0.0.1")
+            start = time.perf_counter()
+            client_module.AsyncronousWait().wait(
+                "untracked", pretty_response=False
+            )
+            assert time.perf_counter() - start < 5.0
+        finally:
+            server.stop()
+
+    def test_retry_after_honored_and_clamped(self, monkeypatch):
+        sleeps = []
+
+        class FakeTime:
+            @staticmethod
+            def sleep(seconds):
+                sleeps.append(seconds)
+
+        # swap the module binding inside client.py only — patching the
+        # real time.sleep would hijack background scheduler threads
+        monkeypatch.setattr(client_module, "time", FakeTime())
+        monkeypatch.setattr(client_module.AsyncronousWait, "WAIT_TIME", 3)
+
+        class FakeResponse:
+            def __init__(self, retry_after):
+                self.headers = (
+                    {"Retry-After": retry_after} if retry_after else {}
+                )
+
+        waiter = client_module.AsyncronousWait()
+        waiter._sleep_retry_after(FakeResponse("7"))
+        waiter._sleep_retry_after(FakeResponse("0.001"))  # clamped up
+        waiter._sleep_retry_after(FakeResponse("9999"))  # clamped down
+        waiter._sleep_retry_after(FakeResponse("soon"))  # malformed
+        assert sleeps == [7.0, 0.1, 60.0, 3.0]
+
+    def test_poll_backoff_jitter_deterministic_and_bounded(self):
+        first = policy.backoff_delay("titanic", 1, base_s=3, cap_s=12)
+        again = policy.backoff_delay("titanic", 1, base_s=3, cap_s=12)
+        assert first == again  # seeded: restarts do not re-roll
+        assert 0.75 * 3 <= first <= 1.25 * 3
+        deep = policy.backoff_delay("titanic", 10, base_s=3, cap_s=12)
+        assert deep <= 12 * 1.25  # capped at 4x the reference pace
+        assert policy.backoff_delay(
+            "other", 1, base_s=3, cap_s=12
+        ) != pytest.approx(first)  # per-name de-synchronization
