@@ -77,6 +77,10 @@ Manifest (JSON)::
         "max_conns": 10000,        #   (>= 1); LO_WEB_MAX_CONNS (503
         "wait_cap_s": 60           #   past it); LO_WEB_WAIT_CAP_S (> 0)
       },
+      "resume": {                  # optional crash-resume knobs
+        "enabled": 1,              #   LO_RESUME (0 = orphaned RUNNING
+        "every_segments": 1        #   jobs fail on restart) / LO_RESUME_
+      },                           #   EVERY_SEGMENTS (integer >= 1)
       "replication": {             # optional replicated store plane
         "enabled": true,           #   (docs/replication.md): the head
         "follower_port": 27028,    #   runs primary + WAL-shipping
@@ -271,6 +275,24 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("web.wait_cap_s must be > 0")
         elif not isinstance(value, int) or value < 1:
             raise SystemExit(f"web.{key} must be an integer >= 1")
+    resume = manifest.setdefault("resume", {})
+    for key in resume:
+        if key not in _RESUME_KNOBS:
+            raise SystemExit(
+                f"unknown resume knob {key!r} (have: "
+                f"{', '.join(sorted(_RESUME_KNOBS))})"
+            )
+        value = resume[key]
+        # same bool-is-int trap as the sched knobs: `"enabled": true`
+        # would stringify to "True" and fail run.sh's strict-0/1
+        # LO_RESUME preflight on every machine
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise SystemExit(f"resume.{key} must be an integer")
+        if key == "enabled":
+            if value not in (0, 1):
+                raise SystemExit("resume.enabled must be 0 or 1")
+        elif value < 1:  # every_segments
+            raise SystemExit("resume.every_segments must be >= 1")
     replication = manifest.setdefault("replication", {})
     for key in replication:
         if key not in _REPLICATION_KNOBS:
@@ -380,6 +402,16 @@ _WEB_KNOBS = {
     "wait_cap_s": "LO_WEB_WAIT_CAP_S",
 }
 
+# manifest resume.<knob> -> the env var every machine receives
+# (docs/robustness.md). Cluster-wide: recovery decisions must be
+# uniform — a machine with resume off would fail the very jobs its
+# peers checkpoint for, and a skewed cadence skews the re-done-work
+# bound the chaos drill asserts on.
+_RESUME_KNOBS = {
+    "enabled": "LO_RESUME",
+    "every_segments": "LO_RESUME_EVERY_SEGMENTS",
+}
+
 # manifest replication.<knob> (docs/replication.md); the head machine
 # runs the whole store plane, every machine's LO_STORE_URL names the
 # primary AND the follower for client-side failover
@@ -447,6 +479,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _WEB_KNOBS.items():
         if knob in manifest.get("web", {}):
             shared[env_var] = str(manifest["web"][knob])
+    for knob, env_var in _RESUME_KNOBS.items():
+        if knob in manifest.get("resume", {}):
+            shared[env_var] = str(manifest["resume"][knob])
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 

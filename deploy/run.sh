@@ -89,6 +89,16 @@
 #   LO_STORE_SYNC_REPL    1 = acks wait for a follower (zero lost
 #                         acknowledged writes; LO_STORE_ACK_TIMEOUT_S)
 #
+# Crash-resume knobs (docs/robustness.md has the full table):
+#   LO_RESUME             1 = segment-checkpointed fits + resume-aware
+#                         recovery (default 1; 0 = orphaned RUNNING
+#                         jobs fail on restart, the pre-resume contract)
+#   LO_RESUME_EVERY_SEGMENTS
+#                         persist a progress artifact every Nth fit
+#                         segment (default 1 = every segment; strictly
+#                         integral >= 1 — larger N trades re-done work
+#                         after a crash for fewer artifact writes)
+#
 # Fault injection (chaos drills ONLY — docs/replication.md):
 #   LO_FAULT_*            named fault points (kill/delay/error/torn);
 #                         validated below so a typo'd point or spec
@@ -154,6 +164,10 @@ for knob in ("LO_AUTO_PROMOTE_S", "LO_QUORUM_GRACE_S",
             seconds = -1.0
         if seconds <= 0:
             raise SystemExit(f"{knob} must be seconds > 0, got {value!r}")
+# crash-resume knobs: LO_RESUME strictly 0/1, checkpoint cadence a
+# strict integer >= 1 — "0.5" silently becoming "never checkpoint"
+# would void the whole crash-resume contract at the worst moment
+config.resume_enabled(); config.resume_every_segments()
 # chaos fault points: a typo'd LO_FAULT_* must fail bring-up loudly
 from learningorchestra_tpu.testing import faults
 try:

@@ -1,4 +1,4 @@
-"""The concurrency-hazard rule family (LO201–LO205).
+"""The concurrency-hazard rule family (LO201–LO206).
 
 Seventeen modules in this codebase hold ``threading.Lock`` / ``RLock`` /
 ``Condition`` state — scheduler queues, the device cache, the serving
@@ -38,6 +38,14 @@ invariants, RacerD-style (lockset reasoning, one module at a time):
   separate ``with``-blocks of one method: an observer acquiring the
   lock between them sees the half-published state (the
   ``_finalize``/DELETE race shape from PR 3).
+- **LO206 unbounded/silent service I/O** — scoped to the HTTP edges
+  (``client.py``, ``services/``, ``serve/``): a ``requests.*`` /
+  ``urlopen`` call without ``timeout=`` parks a thread forever on a
+  half-open connection (the exact hang the crash-resume drill
+  produces by killing a server mid-request), and an
+  ``except Exception: pass`` handler swallows the resulting failure
+  so nobody ever learns the wait hung. Both defeat the robustness
+  contract (docs/robustness.md), so both are flagged at the edge.
 
 Like the LO1xx family the detectors are syntactic — one module at a
 time, no cross-function dataflow — so every finding is explainable by
@@ -746,6 +754,105 @@ def check_lo205(tree: ast.Module, path: str) -> Iterator[Finding]:
 
 
 # --------------------------------------------------------------------
+# LO206 — unbounded or silently-swallowed service I/O
+# --------------------------------------------------------------------
+
+# PATH-gated to the HTTP edges of the system: the client library, the
+# Flask services, and the serving plane. Everything else (tests, the
+# analyzer itself) talks to in-process objects.
+_LO206_HTTP_TAILS = {
+    "get",
+    "post",
+    "put",
+    "patch",
+    "delete",
+    "head",
+    "options",
+    "request",
+}
+
+
+def _lo206_in_scope(path: str) -> bool:
+    normalized = "/" + path.replace("\\", "/")
+    return (
+        "/services/" in normalized
+        or "/serve/" in normalized
+        or normalized.endswith("/client.py")
+    )
+
+
+def _lo206_swallows(handler: ast.ExceptHandler) -> Optional[str]:
+    """The caught-type name when ``handler`` is a broad catch whose
+    body does nothing (``pass`` / ``...``), else None."""
+    if handler.type is None:
+        caught = "bare except"
+    elif isinstance(handler.type, ast.Name) and handler.type.id in (
+        "Exception",
+        "BaseException",
+    ):
+        caught = f"except {handler.type.id}"
+    else:
+        return None
+    body = handler.body
+    if len(body) == 1 and (
+        isinstance(body[0], ast.Pass)
+        or (
+            isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and body[0].value.value is Ellipsis
+        )
+    ):
+        return caught
+    return None
+
+
+def check_lo206(tree: ast.Module, path: str) -> Iterator[Finding]:
+    """Unbounded HTTP waits and silent broad catches on the service
+    edges. A ``requests.*``/``urlopen`` call with no ``timeout=``
+    blocks until the kernel gives up on a half-open peer (hours); a
+    ``pass``-bodied broad except then hides that it ever happened."""
+    if not _lo206_in_scope(path):
+        return
+    seen: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func) or ""
+            root = name.split(".", 1)[0]
+            tail = _last_part(name)
+            is_http = (
+                root == "requests" and tail in _LO206_HTTP_TAILS
+            ) or tail == "urlopen"
+            if (
+                is_http
+                and not any(kw.arg == "timeout" for kw in node.keywords)
+                and node.lineno not in seen
+            ):
+                seen.add(node.lineno)
+                yield Finding(
+                    "",
+                    node.lineno,
+                    "LO206",
+                    f"`{name}()` without `timeout=` — a half-open "
+                    "connection (peer killed mid-request) parks this "
+                    "thread forever; every service/client HTTP call "
+                    "must bound its wait",
+                )
+        elif isinstance(node, ast.ExceptHandler):
+            caught = _lo206_swallows(node)
+            if caught is not None and node.lineno not in seen:
+                seen.add(node.lineno)
+                yield Finding(
+                    "",
+                    node.lineno,
+                    "LO206",
+                    f"`{caught}: pass` on a service edge swallows "
+                    "every failure silently — log it "
+                    "(traceback.print_exc()) or narrow the catch; an "
+                    "edge that eats errors cannot be operated",
+                )
+
+
+# --------------------------------------------------------------------
 # registry
 # --------------------------------------------------------------------
 
@@ -766,5 +873,9 @@ CONCURRENCY_RULES = {
     "LO205": (
         check_lo205,
         "guarded attribute mutation torn across separate lock scopes",
+    ),
+    "LO206": (
+        check_lo206,
+        "untimed HTTP call or silent broad except on a service edge",
     ),
 }

@@ -115,16 +115,37 @@ class AsyncronousWait:
     def _wait_push(self, reader, filename: str) -> bool:
         """Long-poll ``GET /jobs/<filename>/wait`` until the tracking
         job goes terminal. Returns False to fall back to metadata
-        polling (job unknown here, or the push route went away)."""
+        polling (job unknown here, or the push route went away).
+
+        A connection error mid-poll is NOT an answer — it is a server
+        restart (the exact event crash resume exists for): back off
+        with the seeded jitter, re-probe the capability once, and
+        re-park. The restarted server resolves the wait when the
+        resumed job finishes; one that no longer advertises push (or
+        stays unreachable) sends the wait to the polling fallback."""
         base = self._service_base(reader)
         url = f"{base}/jobs/{urllib.parse.quote(filename, safe='')}/wait"
+        attempt = 0
         while True:
             try:
                 response = requests.get(
                     url, params={"timeout": "25"}, timeout=40
                 )
             except requests.RequestException:
-                return False
+                attempt += 1
+                time.sleep(
+                    _policy.backoff_delay(
+                        filename,
+                        attempt,
+                        base_s=self.WAIT_TIME,
+                        cap_s=self.MAX_WAIT_TIME,
+                    )
+                )
+                self._push_probe_cache.pop(base, None)
+                if not self._push_supported(reader):
+                    return False
+                continue
+            attempt = 0
             if response.status_code in (429, 503):
                 self._sleep_retry_after(response)
                 continue
@@ -162,6 +183,7 @@ class AsyncronousWait:
             response = requests.get(
                 url=reader._url(filename),
                 params={"skip": "0", "limit": "1", "query": "{}"},
+                timeout=40,
             )
             if response.status_code in (429, 503):
                 self._sleep_retry_after(response)
@@ -188,6 +210,11 @@ class _RestClient:
     input dataset's ``finished`` flag)."""
 
     _RESOURCE = ""
+    # Every request carries a timeout (analysis LO206: an untimed
+    # socket hangs forever on a half-open connection). Generous on
+    # purpose: mutating calls can run a synchronous model build on the
+    # server, so the ceiling bounds a dead peer, not a slow one.
+    _TIMEOUT_S = 3600
 
     def __init__(self, port: str):
         global cluster_url
@@ -203,21 +230,33 @@ class _RestClient:
 
     def _get(self, suffix: str = "", params=None, pretty_response: bool = True):
         return self._treat(
-            requests.get(url=self._url(suffix), params=params), pretty_response
+            requests.get(
+                url=self._url(suffix), params=params, timeout=self._TIMEOUT_S
+            ),
+            pretty_response,
         )
 
     def _post(self, suffix: str = "", body=None, pretty_response: bool = True):
         return self._treat(
-            requests.post(url=self._url(suffix), json=body), pretty_response
+            requests.post(
+                url=self._url(suffix), json=body, timeout=self._TIMEOUT_S
+            ),
+            pretty_response,
         )
 
     def _patch(self, suffix: str = "", body=None, pretty_response: bool = True):
         return self._treat(
-            requests.patch(url=self._url(suffix), json=body), pretty_response
+            requests.patch(
+                url=self._url(suffix), json=body, timeout=self._TIMEOUT_S
+            ),
+            pretty_response,
         )
 
     def _delete(self, suffix: str = "", pretty_response: bool = True):
-        return self._treat(requests.delete(url=self._url(suffix)), pretty_response)
+        return self._treat(
+            requests.delete(url=self._url(suffix), timeout=self._TIMEOUT_S),
+            pretty_response,
+        )
 
     def _wait_finished(self, filename: str, pretty_response: bool) -> None:
         self.asyncronous_wait.wait(filename, pretty_response)

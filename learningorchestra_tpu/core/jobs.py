@@ -25,6 +25,7 @@ durable journal the next process replays after a crash.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 import traceback
@@ -96,6 +97,12 @@ class JobRecord:
     # GET /jobs/<name>/wait resolves a bare filename through this, so
     # a client that only knows "titanic" finds "ingest:titanic".
     collection: Optional[str] = None
+    # structured per-job detail the work itself attaches while running
+    # (JobHandle.annotate) — e.g. a multi-classifier build's per-name
+    # outcome map when one member fails (``finished_partial``). Rides
+    # as_dict, so GET /jobs/<name> and the /wait terminal body surface
+    # it without route changes.
+    detail: Optional[dict] = None
 
     @property
     def correlation_id(self) -> Optional[str]:
@@ -114,12 +121,59 @@ class JobRecord:
             "attempts": self.attempts,
             "correlation_id": self.correlation_id,
             "collection": self.collection,
+            "detail": self.detail,
         }
 
     def trace_dict(self) -> dict:
         out = self.as_dict()
         out["trace"] = self.trace.as_dict() if self.trace is not None else None
         return out
+
+
+class JobHandle:
+    """The running job's back-channel to its own record and journal.
+
+    Bound by the worker around ``fn`` (:func:`current_job_handle`), so
+    deep work — the model builder, several layers below the JobManager —
+    can attach structured detail and journal ``progress`` events without
+    threading the manager through every signature. NOTE: contextvars do
+    not cross thread-pool boundaries; work that fans out (the builder's
+    per-classifier pool) must capture the handle once at entry and pass
+    it explicitly.
+    """
+
+    def __init__(self, manager: "JobManager", record: JobRecord):
+        self._manager = manager
+        self._record = record
+
+    @property
+    def name(self) -> str:
+        return self._record.name
+
+    def annotate(self, **detail) -> None:
+        """Merge fields into the record's ``detail`` dict (whole-dict
+        replace, so a concurrent as_dict never sees a half-written
+        map)."""
+        merged = dict(self._record.detail or {})
+        merged.update(detail)
+        self._record.detail = merged
+
+    def progress(self, **fields) -> None:
+        """Append a durable ``progress`` event to the job journal —
+        best-effort, like every journal write; recovery folds these
+        into the resume payload for an orphaned RUNNING job."""
+        self._manager._journal_event(self._record, "progress", **fields)
+
+
+_JOB_HANDLE: contextvars.ContextVar[Optional[JobHandle]] = (
+    contextvars.ContextVar("lo_job_handle", default=None)
+)
+
+
+def current_job_handle() -> Optional[JobHandle]:
+    """The JobHandle of the job running on this thread, or None for
+    work executed outside the JobManager (library use, tests)."""
+    return _JOB_HANDLE.get()
 
 
 class JobManager:
@@ -468,6 +522,7 @@ class JobManager:
         self._jobs_running.inc()
         self._journal_event(record, "started", attempt=task.attempt)
         error: Optional[BaseException] = None
+        handle_token = _JOB_HANDLE.set(JobHandle(self, record))
         try:
             with _cancel.bind(task.token), _tracing.activate(
                 record.trace
@@ -481,6 +536,7 @@ class JobManager:
         except BaseException as caught:  # noqa: BLE001 — classified below
             error = caught
         finally:
+            _JOB_HANDLE.reset(handle_token)
             self._jobs_running.dec()
         if error is None:
             self._finalize(

@@ -19,8 +19,9 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import traceback
 from concurrent.futures import ThreadPoolExecutor, wait
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 from jax.sharding import Mesh
@@ -30,10 +31,13 @@ from learningorchestra_tpu.core.store import DocumentStore, ROW_ID
 from learningorchestra_tpu.core.table import insert_columns_batched
 from learningorchestra_tpu.frame.dataframe import DataFrame
 from learningorchestra_tpu.frame.pyspark_compat import run_preprocessor
+from learningorchestra_tpu.ml import progress as _progress
 from learningorchestra_tpu.ml.base import CLASSIFIER_NAMES, make_classifier
 from learningorchestra_tpu.sched import cancel as _cancel
-from learningorchestra_tpu.sched.cancel import check_cancelled
+from learningorchestra_tpu.sched import config as _sched_config
+from learningorchestra_tpu.sched.cancel import JobCancelledError, check_cancelled
 from learningorchestra_tpu.telemetry import tracing as _tracing
+from learningorchestra_tpu.testing import faults as _faults
 from learningorchestra_tpu.utils.dtypepolicy import dtype_policy
 from learningorchestra_tpu.utils.profiling import PhaseTimer, trace
 
@@ -135,7 +139,7 @@ class PredictionWriter:
         self._futures: list = []
         self._lock = threading.Lock()
 
-    def submit(self, fn) -> None:
+    def submit(self, fn, name: Optional[str] = None) -> None:
         context = _tracing.capture()
 
         def run():
@@ -143,15 +147,22 @@ class PredictionWriter:
                 return fn()
 
         with self._lock:
-            self._futures.append(self._pool.submit(run))
+            self._futures.append((name, self._pool.submit(run)))
 
-    def barrier(self) -> None:
-        """Drain every pending write; re-raise the first failure."""
+    def barrier(self) -> list:
+        """Drain every pending write; returns ``[(name, exception)]``
+        for the writes that failed instead of raising — a failed
+        write-back fails THAT classifier's outcome (the partial-results
+        contract), not the whole build."""
         self._pool.shutdown(wait=True)
         with self._lock:
             futures, self._futures = self._futures, []
-        for future in futures:
-            future.result()
+        failures = []
+        for name, future in futures:
+            error = future.exception()
+            if error is not None:
+                failures.append((name, error))
+        return failures
 
 
 def _prediction_columns(predicted_df: DataFrame) -> dict[str, Column]:
@@ -194,6 +205,8 @@ def train_one(
     write_outputs: bool = True,
     models_dir: Optional[str] = None,
     writer: Optional[PredictionWriter] = None,
+    sink: Optional[_progress.ProgressSink] = None,
+    on_durable: Optional[Callable[[dict], None]] = None,
 ) -> dict:
     """Fit + evaluate + persist one classifier (the reference's
     ``classificator_handler``, model_builder.py:178-230). Returns the
@@ -214,7 +227,14 @@ def train_one(
     metadata as ``model_checkpoint`` — the durability the reference
     lacks (its models die with the request, model_builder.py:232-247;
     SURVEY.md §5 flags this); :func:`predict_with_model` serves
-    predictions from the artifact without refitting."""
+    predictions from the artifact without refitting.
+
+    ``sink`` makes the fit crash-resumable: it is bound as the ambient
+    progress sink around the fit, so the segment loops persist progress
+    artifacts (ml/progress.py). ``on_durable(metadata)`` fires once this
+    classifier's outputs have durably landed (after the metadata insert
+    — on the writer thread when writes overlap); build_model journals
+    the per-classifier completion there."""
     output_name = f"{prediction_filename}_prediction_{classificator_name}"
     metadata = {
         "filename": output_name,
@@ -233,13 +253,16 @@ def train_one(
     y_train = features_training.label_vector(LABEL_COL)
 
     classifier = make_classifier(classificator_name, mesh=mesh)
+    _faults.fire(
+        "builder.phase", phase="fit", classificator=classificator_name
+    )
     # dtype rides the phase attrs so a trace says which LO_DTYPE_POLICY
     # (f32 vs bf16 feature matrices) produced these numbers
     with timer.phase("fit", rows=len(X_train), dtype=dtype_policy()):
         # the rendezvous guard serializes the whole dispatch+drain on a
         # single-process CPU backend (see _CPU_RENDEZVOUS_LOCK); a
         # no-op on real accelerators and under multi-process SPMD
-        with _collective_dispatch_guard():
+        with _collective_dispatch_guard(), _progress.bind_sink(sink):
             model = classifier.fit(X_train, y_train)
             # drain the async dispatch queue inside the fit phase:
             # without this the device time lands on whichever later
@@ -267,6 +290,11 @@ def train_one(
         )
 
         artifact = checkpoint_path(models_dir, output_name)
+        _faults.fire(
+            "builder.phase",
+            phase="checkpoint",
+            classificator=classificator_name,
+        )
         with timer.phase("checkpoint"):
             # the gather may be a cross-host collective (model-axis
             # sharded params): ALL processes enter it; only the
@@ -290,6 +318,11 @@ def train_one(
         X_eval = features_evaluation.device_matrix(FEATURES_COL, model.mesh)
         y_eval = features_evaluation.device_labels(LABEL_COL, model.mesh)
         X_test = features_testing.device_matrix(FEATURES_COL, model.mesh)
+        _faults.fire(
+            "builder.phase",
+            phase="evaluate",
+            classificator=classificator_name,
+        )
         with timer.phase("evaluate", rows=features_evaluation.count()):
             # the collective eval is THE dispatch the PR 8 latent
             # deadlock fired on: two warm builds' evals interleaving
@@ -315,6 +348,7 @@ def train_one(
         write_outputs,
         prediction=prediction,
         writer=writer,
+        on_durable=on_durable,
     )
 
 
@@ -328,6 +362,7 @@ def _predict_and_write(
     write_outputs: bool,
     prediction: Optional[tuple] = None,
     writer: Optional[PredictionWriter] = None,
+    on_durable: Optional[Callable[[dict], None]] = None,
 ) -> dict:
     """Predict over the test frame and persist the prediction
     collection + its metadata document — the shared tail of
@@ -351,6 +386,11 @@ def _predict_and_write(
     """
     if prediction is None:  # no eval split: predict is its own pass
         X_test = features_testing.device_matrix(FEATURES_COL, model.mesh)
+        _faults.fire(
+            "builder.phase",
+            phase="predict",
+            classificator=metadata.get("classificator"),
+        )
         with timer.phase("predict", rows=features_testing.count()):
             # one forward pass yields labels AND probabilities
             with _collective_dispatch_guard():
@@ -371,16 +411,26 @@ def _predict_and_write(
     )
 
     def flush() -> None:
+        _faults.fire(
+            "builder.phase",
+            phase="write",
+            classificator=metadata.get("classificator"),
+        )
         store.drop(output_name)
         with timer.phase("write", rows=write_rows, bytes=write_bytes):
             insert_columns_batched(store, output_name, columns)
         metadata["timings"] = timer.as_metadata()
         store.insert_one(output_name, metadata)
+        # the metadata document is the durability proof (it lands
+        # strictly after the rows): only now is this classifier's
+        # completion journal-worthy
+        if on_durable is not None:
+            on_durable(metadata)
 
     if writer is None:
         flush()
     else:
-        writer.submit(flush)
+        writer.submit(flush, metadata.get("classificator"))
     return metadata
 
 
@@ -413,6 +463,36 @@ def _alias_if_equal(
     return features_evaluation
 
 
+class _ResumedMemberFailure(RuntimeError):
+    """A classifier the pre-crash run already journaled as permanently
+    failed: the resumed build records the original error without
+    re-running the member."""
+
+
+def _fold_resume(resume: Optional[list]) -> dict[str, dict]:
+    """Journaled ``progress`` events → per-classifier terminal status.
+    Later events win (a ``failed`` member re-journaled ``finished`` by
+    a later resume attempt is finished). Segment events carry no
+    ``status`` and fold to nothing — the fits read their own progress
+    artifacts, which hold strictly more than the journal line."""
+    done: dict[str, dict] = {}
+    for event in resume or []:
+        name = event.get("classificator")
+        status = event.get("status")
+        if name and status in ("finished", "failed"):
+            done[name] = {"status": status, "error": event.get("error")}
+    return done
+
+
+def _mesh_key(mesh: Optional[Mesh]) -> str:
+    """The (resolved) mesh's structural signature as a string — the
+    progress artifact's mesh-layout validation component."""
+    from learningorchestra_tpu.core.devcache import mesh_signature
+    from learningorchestra_tpu.ml.base import resolve_mesh
+
+    return str(mesh_signature(resolve_mesh(mesh)))
+
+
 def build_model(
     store: DocumentStore,
     training_filename: str,
@@ -422,23 +502,41 @@ def build_model(
     mesh: Optional[Mesh] = None,
     write_outputs: bool = True,
     models_dir: Optional[str] = None,
+    resume: Optional[list] = None,
 ) -> list[dict]:
     """The reference's ``build_model`` (model_builder.py:133-176):
-    preprocess once, then one thread per classifier."""
+    preprocess once, then one thread per classifier.
+
+    ``resume`` is the journaled ``progress`` event list recovery hands
+    a re-enqueued build (sched/recovery.py): classifiers it records as
+    durably finished are skipped (their stored metadata is returned),
+    ones it records as permanently failed stay failed without a re-run,
+    and everything else refits — each fit picking up its own progress
+    artifact, so only the remaining segments execute."""
     import jax
 
     unknown = [n for n in classificators_list if n not in CLASSIFIER_NAMES]
     if unknown:
         raise KeyError(f"invalid classificator names {unknown}")
 
+    # Captured ONCE on the job worker thread (contextvars do not cross
+    # the per-classifier pool below): the handle is how the build
+    # journals per-classifier completions and attaches the partial-
+    # results detail to its own record. None for library callers.
+    from learningorchestra_tpu.core.jobs import current_job_handle
+
+    handle = current_job_handle()
+
     # Span-per-stage: with phase spans from each train_one's PhaseTimer
     # these cover the build end to end, so /jobs/<name>/trace accounts
     # for (nearly) the whole job wall-clock — the 61%-dtype-cast class
     # of fact becomes a one-request diagnosis.
+    _faults.fire("builder.phase", phase="load_data")
     with _tracing.span("load_data"):
         training_df = load_dataframe(store, training_filename)
         testing_df = load_dataframe(store, test_filename)
         _tracing.annotate(rows=training_df.count() + testing_df.count())
+    _faults.fire("builder.phase", phase="preprocess")
     with _tracing.span("preprocess"):
         out = run_preprocessor(preprocessor_code, training_df, testing_df)
         _tracing.annotate(rows=out["features_training"].count())
@@ -493,6 +591,55 @@ def build_model(
         except OSError:  # unwritable/full trace volume: run untraced
             _TRACE_LOCK.release()
             tracing = False
+    # Crash resume needs a durable home for progress artifacts: they
+    # live beside the model checkpoints. Resolve the env fallback here
+    # so the sink and train_one agree on one directory (train_one keeps
+    # its own fallback for direct callers). Coordinator-only, single-
+    # host only: a resumed in-process build cannot rejoin a multi-host
+    # collective stream, so workers never persist progress.
+    if models_dir is None:
+        models_dir = os.environ.get("LO_MODELS_DIR")
+    make_sink: Optional[Callable] = None
+    if (
+        write_outputs
+        and models_dir
+        and not multi_process
+        and _sched_config.resume_enabled()
+    ):
+        # The devcache-style validation key: a progress artifact is
+        # only resumable against the SAME input content, dtype policy,
+        # and mesh layout that produced it — anything else is a clean
+        # restart, never a silently-wrong model. Content fingerprints,
+        # not collection revs: revs reseed per boot, and the restarted
+        # process is the one that needs the artifact to validate.
+        sink_meta = {
+            "training_fp": _progress.collection_fingerprint(
+                store, training_filename
+            ),
+            "test_fp": _progress.collection_fingerprint(
+                store, test_filename
+            ),
+            "dtype_policy": dtype_policy(),
+            "mesh": _mesh_key(mesh),
+        }
+        every = _sched_config.resume_every_segments()
+        os.makedirs(models_dir, exist_ok=True)
+
+        def make_sink(name: str) -> _progress.ProgressSink:
+            output_name = f"{test_filename}_prediction_{name}"
+            on_segment = None
+            if handle is not None:
+                def on_segment(seg: int, _name=name) -> None:
+                    handle.progress(
+                        classificator=_name, kind="segment", segment=seg
+                    )
+            return _progress.ProgressSink(
+                _progress.progress_path(models_dir, output_name),
+                dict(sink_meta),
+                every=every,
+                on_segment=on_segment,
+            )
+
     try:
         return _build_model_traced(
             store,
@@ -504,6 +651,9 @@ def build_model(
             models_dir,
             max_workers,
             trace_dir,
+            resume_done=_fold_resume(resume),
+            make_sink=make_sink,
+            handle=handle,
         )
     finally:
         if tracing:
@@ -520,8 +670,10 @@ def _build_model_traced(
     models_dir,
     max_workers,
     trace_dir,
+    resume_done=None,
+    make_sink=None,
+    handle=None,
 ) -> list[dict]:
-    results: list[dict] = []
     # contextvars don't cross pool threads: hand each worker the ambient
     # (trace, span) so its train span — and the PhaseTimer phases inside
     # — nest under the request/job trace, and the ambient cancel token
@@ -535,6 +687,7 @@ def _build_model_traced(
         write_outputs and os.environ.get("LO_WRITE_OVERLAP", "1") != "0"
     )
     writer = PredictionWriter() if overlap else None
+    resume_done = resume_done or {}
 
     def run_train(name: str) -> dict:
         with _tracing.attach(context), _cancel.bind(cancel_token):
@@ -542,6 +695,36 @@ def _build_model_traced(
             # already in flight run to their own next check inside
             # train_one, queued ones never start
             check_cancelled()
+            sink = make_sink(name) if make_sink is not None else None
+            prior = resume_done.get(name)
+            if prior is not None and prior.get("status") == "failed":
+                # journaled as permanently failed before the crash:
+                # resume skips the member, keeping the original error
+                if sink is not None:
+                    sink.discard()
+                raise _ResumedMemberFailure(
+                    prior.get("error") or "failed before service restart"
+                )
+            if prior is not None and prior.get("status") == "finished":
+                stored = store.find_one(
+                    f"{test_filename}_prediction_{name}", {ROW_ID: 0}
+                )
+                if stored is not None:
+                    # durably completed before the crash (the journal
+                    # line lands only after the metadata insert): skip
+                    # the refit, return the stored outcome
+                    if sink is not None:
+                        sink.discard()
+                    return stored
+                # journaled finished but the outputs are gone (dropped
+                # collection): fall through and rebuild
+
+            def durable(metadata, _name=name, _sink=sink) -> None:
+                if handle is not None:
+                    handle.progress(classificator=_name, status="finished")
+                if _sink is not None:
+                    _sink.discard()
+
             with _tracing.span(f"train:{name}", classificator=name):
                 return train_one(
                     store,
@@ -554,6 +737,8 @@ def _build_model_traced(
                     write_outputs,
                     models_dir,
                     writer=writer,
+                    sink=sink,
+                    on_durable=durable,
                 )
 
     try:
@@ -561,17 +746,97 @@ def _build_model_traced(
             max_workers=max_workers
         ) as pool:
             futures = [
-                pool.submit(run_train, name) for name in classificators_list
+                (name, pool.submit(run_train, name))
+                for name in classificators_list
             ]
-            wait(futures)
+            wait([future for _, future in futures])
     finally:
         # End-of-job barrier: no build returns (or fails) with writes
-        # still in flight; a write failure fails the job like any other.
-        if writer is not None:
-            writer.barrier()
-    for future in futures:
-        results.append(future.result())
-    return results
+        # still in flight; a failed write-back fails that MEMBER.
+        write_failures = writer.barrier() if writer is not None else []
+    return _collect_outcomes(
+        classificators_list, futures, write_failures, handle
+    )
+
+
+def _collect_outcomes(
+    classificators_list, futures, write_failures, handle
+) -> list[dict]:
+    """Fold per-classifier futures + write-back failures into the
+    build's result — the partial-results contract: ONE failed member
+    no longer fails the whole job. Outcomes:
+
+    - all succeeded → the metadata list, as ever;
+    - any cancelled → the cancellation re-raises (job CANCELLED);
+    - all failed → the single member's exception re-raises verbatim
+      (single-classifier builds keep their reference-parity 500
+      bodies), several failures raise one aggregate;
+    - mixed → the successes return, the job FINISHES, and the record
+      carries ``detail.result = "finished_partial"`` with a per-name
+      status map (surfaced by GET /jobs/<name> and the /wait body).
+
+    Failed members are journaled (``status="failed"``) so a resumed
+    run skips them instead of re-running a permanent failure."""
+    succeeded: list[dict] = []
+    errors: dict[str, BaseException] = {}
+    cancelled: Optional[BaseException] = None
+    write_failed = dict(write_failures)
+    for name, future in futures:
+        try:
+            result = future.result()
+        except JobCancelledError as interruption:
+            cancelled = interruption
+            continue
+        except BaseException as error:  # noqa: BLE001 — folded below
+            errors[name] = error
+            continue
+        if name in write_failed:
+            # compute finished, but the overlapped write-back failed:
+            # this member's outputs never landed
+            errors[name] = write_failed[name]
+            continue
+        succeeded.append(result)
+    if cancelled is not None:
+        raise cancelled
+    for name, error in errors.items():
+        if isinstance(error, _ResumedMemberFailure):
+            continue  # already journaled by the pre-crash run
+        traceback.print_exception(type(error), error, error.__traceback__)
+        if handle is not None:
+            handle.progress(
+                classificator=name,
+                status="failed",
+                error=_member_error(error),
+            )
+    if not errors:
+        return succeeded
+    statuses = {
+        name: (
+            {"status": "failed", "error": _member_error(errors[name])}
+            if name in errors
+            else {"status": "finished"}
+        )
+        for name in classificators_list
+    }
+    if not succeeded:
+        if len(errors) == 1:
+            raise next(iter(errors.values()))
+        raise RuntimeError(
+            "all classifiers failed: "
+            + "; ".join(
+                f"{name}: {_member_error(error)}"
+                for name, error in errors.items()
+            )
+        )
+    if handle is not None:
+        handle.annotate(result="finished_partial", classifiers=statuses)
+    return succeeded
+
+
+def _member_error(error: BaseException) -> str:
+    if isinstance(error, _ResumedMemberFailure):
+        return str(error)  # already formatted by the pre-crash run
+    return f"{type(error).__name__}: {error}"
 
 
 def predict_with_model(

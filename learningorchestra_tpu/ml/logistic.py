@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from learningorchestra_tpu.parallel.mesh import MODEL_AXIS, model_size
+from learningorchestra_tpu.ml import progress as _progress
 from learningorchestra_tpu.ml.base import (
     FittedModel,
     infer_num_classes,
@@ -336,19 +337,72 @@ def _fit(params, X, y, mask, max_iter: int, l2, tol: float = _LR_TOL):
     history: list[float] = []
     window = _LR_STOP_DELTAS + 1
     segment = _fit_segment_runner()
-    for _ in range(max_iter // iters):
+    total_segments = max_iter // iters
+    # Crash resume: a sink bound by ml/builder.py means this fit should
+    # persist per-segment progress and pick up any prior run's artifact.
+    # The artifact must match this call's segmentation exactly (iters /
+    # max_iter / l2) on top of the sink's own rev/dtype/mesh key — any
+    # drift restarts the fit clean.
+    sink = _progress.current_sink()
+    start = 0
+    if sink is not None:
+        restored = sink.load("logistic")
+        if restored is not None:
+            done, arrays, scalars = restored
+            state = None
+            if (
+                scalars.get("iters") == iters
+                and scalars.get("max_iter") == max_iter
+                and scalars.get("l2") == float(np.asarray(l2))
+                and 0 < done <= total_segments
+                and len(arrays) >= 1
+            ):
+                state = _progress.device_restore(
+                    (params, opt_state), arrays[:-1]
+                )
+            if state is None:
+                sink.discard()
+            else:
+                params, opt_state = state
+                losses.append(jnp.asarray(arrays[-1]))
+                history.extend(
+                    float(v) for v in scalars.get("history") or []
+                )
+                del history[:-window]
+                start = done
+                _progress.segments_skipped(done)
+    for index in range(start, total_segments):
+        # plateau check at the TOP so a resumed fit that had already
+        # converged (crash between progress save and checkpoint write)
+        # stops exactly where the uninterrupted run did — running one
+        # more segment here would break bit-identity
+        if tol > 0 and _plateaued(history, tol, window):
+            break
         params, opt_state, segment_losses = segment(
             params, opt_state, X, y, mask, iters, l2
         )
         losses.append(segment_losses)
-        if tol <= 0:  # explicit "run every iteration"
-            continue
-        # One host transfer either way: the losses come back as one
-        # array.
-        history.extend(float(v) for v in np.asarray(segment_losses))
-        del history[:-window]
-        if _plateaued(history, tol, window):
-            break
+        if tol > 0:
+            # One host transfer either way: the losses come back as one
+            # array.
+            history.extend(float(v) for v in np.asarray(segment_losses))
+            del history[:-window]
+        if sink is not None:
+            sink.save(
+                "logistic",
+                index + 1,
+                [
+                    np.asarray(leaf)
+                    for leaf in jax.tree.leaves((params, opt_state))
+                ]
+                + [np.concatenate([np.asarray(l) for l in losses])],
+                {
+                    "iters": iters,
+                    "max_iter": max_iter,
+                    "l2": float(np.asarray(l2)),
+                    "history": list(history),
+                },
+            )
     return params, (
         jnp.concatenate(losses) if len(losses) > 1 else losses[0]
     )

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from learningorchestra_tpu.ml import progress as _progress
 from learningorchestra_tpu.ml.base import (
     FittedModel,
     infer_num_classes,
@@ -584,11 +585,56 @@ def _gbt_fit(bins, y, weights, max_depth, max_bins, rounds, step):
     )
     heaps = []
     rounds_chunk = _gbt_rounds_runner()
-    for _ in range(rounds // chunk):
+    total_chunks = rounds // chunk
+    # Crash resume (see ml/progress.py): margins + the heaps built so
+    # far are enough to replay the remaining chunks bit-identically —
+    # f0 is recomputed deterministically from y/weights above. The
+    # artifact must match this call's chunking and hyperparameters on
+    # top of the sink's rev/dtype/mesh key, else restart clean.
+    scalars = {
+        "chunk": chunk,
+        "rounds": rounds,
+        "max_depth": max_depth,
+        "max_bins": max_bins,
+        "step": float(np.asarray(step)),
+    }
+    start = 0
+    sink = _progress.current_sink()
+    if sink is not None:
+        restored = sink.load("gbt")
+        if restored is not None:
+            done, arrays, saved = restored
+            state = None
+            if (
+                all(saved.get(key) == scalars[key] for key in scalars)
+                and 0 < done <= total_chunks
+                and len(arrays) == 4
+                and all(a.shape[0] == done * chunk for a in arrays[1:])
+            ):
+                state = _progress.device_restore(margins, [arrays[0]])
+            if state is None:
+                sink.discard()
+            else:
+                margins = state
+                heaps.append(tuple(jnp.asarray(a) for a in arrays[1:]))
+                start = done
+                _progress.segments_skipped(done)
+    for index in range(start, total_chunks):
         margins, features_heap, bins_heap, leaf_values = rounds_chunk(
             bins, y, weights, margins, max_depth, max_bins, chunk, step
         )
         heaps.append((features_heap, bins_heap, leaf_values))
+        if sink is not None:
+            sink.save(
+                "gbt",
+                index + 1,
+                [np.asarray(margins)]
+                + [
+                    np.concatenate([np.asarray(h[i]) for h in heaps])
+                    for i in range(3)
+                ],
+                scalars,
+            )
     if len(heaps) == 1:
         features_heap, bins_heap, leaf_values = heaps[0]
     else:

@@ -62,6 +62,7 @@ from typing import Any, Callable, Optional
 from learningorchestra_tpu.sched import config
 from learningorchestra_tpu.sched.cancel import CancelToken, JobCancelledError
 from learningorchestra_tpu.telemetry import tracing as _tracing
+from learningorchestra_tpu.testing import faults as _faults
 
 # Member lifecycle (all transitions under the coalescer's condition
 # lock). PENDING → LEADER when the member's own task reaches a worker
@@ -271,6 +272,10 @@ class Coalescer:
             self._fused += 1
             self._members += len(batch)
         try:
+            # chaos point: an injected error here must land as
+            # per-member failures through the delivery path below, never
+            # a wedged batch (testing/faults.py)
+            _faults.fire("coalesce.dispatch", jobs=len(live))
             with _tracing.span(
                 "coalesce:dispatch", jobs=len(live), masked=masked
             ):

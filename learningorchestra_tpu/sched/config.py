@@ -27,6 +27,14 @@ env var                      default  meaning
                                       every device job dispatches alone)
 ``LO_COALESCE_MAX_JOBS``     32       max member jobs fused into one
                                       vmap-across-jobs dispatch
+``LO_RESUME``                1        crash resume: orphaned RUNNING jobs
+                                      with a resumable op re-enqueue with
+                                      their journaled progress instead of
+                                      going FAILED (strict 0/1)
+``LO_RESUME_EVERY_SEGMENTS`` 1        persist a fit-progress artifact every
+                                      N segments (integral >= 1; higher =
+                                      less checkpoint I/O, more recompute
+                                      after a crash)
 ===========================  =======  =====================================
 """
 
@@ -118,6 +126,25 @@ def coalesce_window_s() -> float:
     ``0`` disables coalescing entirely (passthrough: every coalescible
     device job runs as its own dispatch)."""
     return _float_env("LO_COALESCE_WINDOW_MS", 2.0, 0.0) / 1000.0
+
+
+def resume_enabled() -> bool:
+    """Crash resume for device jobs (docs/robustness.md). Strict 0/1:
+    ``LO_RESUME=yes`` silently meaning "off" (or "on") is exactly the
+    ambiguity the deploy preflight exists to refuse."""
+    raw = os.environ.get("LO_RESUME", "").strip()
+    if not raw:
+        return True
+    if raw not in ("0", "1"):
+        raise ValueError(f"LO_RESUME must be 0 or 1, got {raw!r}")
+    return raw == "1"
+
+
+def resume_every_segments() -> int:
+    """Persist a fit-progress artifact every N segments. Strictly
+    integral >= 1 — ``1.5`` silently truncating would double the
+    recompute window an operator thought they configured."""
+    return _int_env("LO_RESUME_EVERY_SEGMENTS", 1)
 
 
 def coalesce_max_jobs() -> int:

@@ -3,7 +3,11 @@
 Every scheduler admission writes a ``submitted`` document into the
 ``__lo_jobs__`` collection of the :class:`DocumentStore`; every state
 transition (``started``, ``retry``, ``finished``, ``failed``,
-``cancelled``, ``rejected``, ``orphaned``) appends another. The store's
+``cancelled``, ``rejected``, ``orphaned``) appends another. Running
+work may additionally append ``progress`` documents (JobHandle.progress
+— per-classifier completions, fit-segment saves); these are NOT state
+transitions, they are the resume payload recovery hands back to a
+resumable op after a crash (docs/robustness.md). The store's
 WAL makes the journal survive a crash, which is what recovery
 (sched/recovery.py) replays — task lineage in the Ray sense, scoped to
 what this system needs: enough to re-enqueue work that never started
@@ -27,6 +31,8 @@ import time
 import traceback
 from typing import Iterator, Optional
 
+from learningorchestra_tpu.testing import faults as _faults
+
 JOURNAL_COLLECTION = "__lo_jobs__"
 
 TERMINAL_EVENTS = frozenset(
@@ -35,16 +41,23 @@ TERMINAL_EVENTS = frozenset(
 
 
 class JobHistory:
-    """One job's folded journal: its submit document plus the last
-    event seen — all recovery needs."""
+    """One job's folded journal: its submit document, the last event
+    seen, and any ``progress`` events the run appended — all recovery
+    needs."""
 
-    __slots__ = ("name", "submit", "last_event", "last_error")
+    __slots__ = ("name", "submit", "last_event", "last_error", "progress")
 
     def __init__(self, name: str, submit: dict):
         self.name = name
         self.submit = submit
         self.last_event = "submitted"
         self.last_error: Optional[str] = None
+        # ``progress`` event documents in append order (per-classifier
+        # completions, segment saves) — the resume payload for an
+        # orphaned RUNNING job. Not a state transition: folding one
+        # must NOT touch last_event, or a started job would stop
+        # looking started.
+        self.progress: list[dict] = []
 
     @property
     def terminal(self) -> bool:
@@ -71,6 +84,10 @@ class JobJournal:
             {key: value for key, value in fields.items() if value is not None}
         )
         try:
+            # chaos point: an injected error here must cost an audit
+            # line, never the job — the same contract as a real store
+            # hiccup (testing/faults.py)
+            _faults.fire("sched.journal.append", job=job, event=event)
             self.store.insert_one(JOURNAL_COLLECTION, document)
         except Exception:  # noqa: BLE001 — journaling must not fail jobs
             traceback.print_exc()
@@ -104,6 +121,9 @@ class JobJournal:
                 # transition without a submit (partial WAL): synthesize
                 # an op-less submit so recovery can still terminate it
                 history = histories[name] = JobHistory(name, event)
+            if kind == "progress":
+                history.progress.append(event)
+                continue
             history.last_event = kind
             history.last_error = event.get("error", history.last_error)
         return histories

@@ -10,17 +10,26 @@ client __init__.py:24-32). On restart, :func:`recover_jobs`:
    the submit document carries the op name and payload, so the work
    reconstructs without the original closure (the lineage idea from
    Ray, reduced to named idempotent operations).
-2. **Marks orphaned RUNNING jobs FAILED** (last event ``started``):
-   appends a terminal ``orphaned`` event and flips the tracked
-   dataset's metadata to ``finished: true`` with an error, so pollers
-   terminate. Never-started jobs with no replay handler get the same
-   terminal treatment — no journal entry is ever left able to hang a
-   client.
+2. **Resumes orphaned RUNNING jobs whose op is resumable** (last event
+   ``started``, op in the resume registry, ``LO_RESUME`` enabled):
+   re-enqueues the work under the same name with the journaled
+   ``progress`` events — per-classifier completions, fit-segment saves
+   — so the resumed run performs only the remaining work. Parked
+   waiters never noticed: same name, same record map, the push hook
+   fires when the resumed run finishes.
+3. **Marks the rest of the orphaned RUNNING jobs FAILED**: appends a
+   terminal ``orphaned`` event and flips the tracked dataset's
+   metadata to ``finished: true`` with an error, so pollers terminate.
+   Never-started jobs with no replay handler get the same terminal
+   treatment — no journal entry is ever left able to hang a client.
 
 Replayable ops are registered by name. ``ingest`` ships built in: it is
 idempotent-by-construction here because only never-STARTED ingests
 replay (a started one may have written partial rows; it is orphaned
-instead). Register more with :func:`register_replay`.
+instead). ``build_model`` registers as BOTH replayable and resumable —
+its outputs are whole-collection drops + atomic checkpoint/progress
+artifacts, so a half-dead build re-runs safely at any point. Register
+more with :func:`register_replay` / :func:`register_resumable`.
 """
 
 from __future__ import annotations
@@ -28,6 +37,7 @@ from __future__ import annotations
 from typing import Callable
 
 from learningorchestra_tpu.core.store import METADATA_ID, ROW_ID
+from learningorchestra_tpu.sched import config as _config
 from learningorchestra_tpu.sched.journal import JobJournal
 from learningorchestra_tpu.sched.scheduler import QueueFullError
 from learningorchestra_tpu.telemetry import metrics as _metrics
@@ -38,9 +48,19 @@ ORPHAN_ERROR = "orphaned by service restart"
 # journaled payload alone.
 _REPLAY_REGISTRY: dict[str, Callable] = {}
 
+# op name -> handler(store, payload, progress). Handlers re-run the
+# work from the journaled payload plus the run's ``progress`` events —
+# ops here declare that a STARTED run is safe to re-execute (atomic
+# outputs, journaled completions).
+_RESUME_REGISTRY: dict[str, Callable] = {}
+
 
 def register_replay(op: str, handler: Callable) -> None:
     _REPLAY_REGISTRY[op] = handler
+
+
+def register_resumable(op: str, handler: Callable) -> None:
+    _RESUME_REGISTRY[op] = handler
 
 
 def _replay_ingest(store, payload: dict) -> None:
@@ -52,11 +72,49 @@ def _replay_ingest(store, payload: dict) -> None:
 register_replay("ingest", _replay_ingest)
 
 
+def _build_model_replay(store, payload: dict, progress=None) -> None:
+    """Re-run (or resume) a model build from its journaled submit
+    payload. Registered at module import — recovery runs BEFORE the
+    web app exists, so this cannot live in a create_app closure."""
+    import jax
+
+    if jax.process_count() > 1:
+        # An in-process resume on one host of a multi-host runner would
+        # enter collective programs the other hosts never join — a
+        # hang, not a recovery. Multi-host builds restart client-side.
+        raise RuntimeError(
+            "build_model replay is single-host only "
+            f"(process_count={jax.process_count()})"
+        )
+    from learningorchestra_tpu.ml.builder import build_model
+
+    build_model(
+        store,
+        payload["training_filename"],
+        payload["test_filename"],
+        payload["preprocessor_code"],
+        list(payload["classificators_list"]),
+        models_dir=payload.get("models_dir"),
+        resume=list(progress or []),
+    )
+
+
+register_replay("build_model", _build_model_replay)
+register_resumable("build_model", _build_model_replay)
+
+
 def _recovered_counter():
     return _metrics.global_registry().counter(
         "lo_sched_recovered_total",
         "Journal-replay outcomes at service restart",
         labels=("outcome",),
+    )
+
+
+def _resumed_counter():
+    return _metrics.global_registry().counter(
+        "lo_sched_resumed_total",
+        "Orphaned RUNNING jobs re-enqueued with journaled progress",
     )
 
 
@@ -119,9 +177,37 @@ def recover_jobs(store, jobs, journal: JobJournal | None = None) -> dict:
         submit = history.submit
         collection = submit.get("collection")
         if history.started:
-            # Orphaned RUNNING job: the process died mid-flight. It may
-            # have half-written output, so it never replays — it fails,
-            # visibly, and its pollers terminate.
+            # Orphaned RUNNING job: the process died mid-flight. An op
+            # in the resume registry declared a started run safe to
+            # re-execute (atomic outputs, journaled completions) — it
+            # re-enqueues under the SAME name with its progress events,
+            # so the resumed run performs only the remaining work and
+            # parked waiters resolve on its completion. Everything else
+            # may have half-written output: it fails, visibly, and its
+            # pollers terminate.
+            resume_handler = _RESUME_REGISTRY.get(submit.get("op"))
+            if resume_handler is not None and _config.resume_enabled():
+                payload = submit.get("payload") or {}
+                try:
+                    jobs.submit(
+                        name,
+                        resume_handler,
+                        store,
+                        payload,
+                        list(history.progress),
+                        store=store if collection else None,
+                        collection=collection,
+                        job_class=submit.get("job_class") or "host",
+                        priority=int(submit.get("priority") or 0),
+                        replay=(submit["op"], payload),
+                    )
+                except QueueFullError:
+                    orphan(name, collection, "dropped")
+                    continue
+                requeued.append(name)
+                counter.labels("resumed").inc()
+                _resumed_counter().inc()
+                continue
             orphan(name, collection, "orphaned")
             continue
         handler = _REPLAY_REGISTRY.get(submit.get("op"))

@@ -38,6 +38,7 @@ import numpy as np
 
 from learningorchestra_tpu.sched.scheduler import QueueFullError
 from learningorchestra_tpu.telemetry import tracing as _tracing
+from learningorchestra_tpu.testing import faults as _faults
 from learningorchestra_tpu.utils.shapegrid import grid_size, pad_axis0
 
 SERVE_CLASS = "serve"
@@ -281,6 +282,12 @@ class MicroBatcher:
 
     def _forward_traced(self, group: list, span) -> None:
         try:
+            # chaos point: an injected error here must land as
+            # per-request errors via the finish() path below, never a
+            # dropped group (testing/faults.py)
+            _faults.fire(
+                "serve.forward", path=group[0].path, requests=len(group)
+            )
             # the span covers the registry lookup too, so its
             # hit/miss verdict (registry.get annotates the ambient
             # span) and a miss's serve:load_model child both land here

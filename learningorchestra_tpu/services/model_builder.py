@@ -191,9 +191,30 @@ def create_app(
             f"build:{body['test_filename']}:"
             f"{'+'.join(body['classificators_list'])}"
         )
+        # The journaled replay payload: everything recovery needs to
+        # re-run (or RESUME — build_model is in the resume registry,
+        # sched/recovery.py) this build after a crash, without the
+        # closure. models_dir rides along because the restarted process
+        # resolves no request-scoped state.
+        replay = (
+            "build_model",
+            {
+                "training_filename": body["training_filename"],
+                "test_filename": body["test_filename"],
+                "preprocessor_code": body["preprocessor_code"],
+                "classificators_list": list(body["classificators_list"]),
+                "models_dir": models_dir,
+            },
+        )
         if body.get("async"):
             try:
-                jobs.submit(job_name, build, body, job_class=DEVICE_CLASS)
+                jobs.submit(
+                    job_name,
+                    build,
+                    body,
+                    job_class=DEVICE_CLASS,
+                    replay=replay,
+                )
             except QueueFullError as error:  # device queue at its cap
                 return too_many_requests(error)
             except ValueError as error:  # same job already active
@@ -211,7 +232,9 @@ def create_app(
         # falls back to untracked execution rather than changing the
         # reference's (racy) allow-both behaviour.
         try:
-            jobs.run_sync(job_name, build, body, job_class=DEVICE_CLASS)
+            jobs.run_sync(
+                job_name, build, body, job_class=DEVICE_CLASS, replay=replay
+            )
         except QueueFullError as error:
             return too_many_requests(error)
         except DuplicateJobError:  # already active: reference parity.
@@ -227,6 +250,7 @@ def create_app(
                     build,
                     body,
                     job_class=DEVICE_CLASS,
+                    replay=replay,
                 )
             except QueueFullError as error:
                 return too_many_requests(error)

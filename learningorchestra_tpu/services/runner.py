@@ -367,6 +367,17 @@ def main() -> None:
         flush=True,
     )
 
+    # ...and the crash-resume knobs (docs/robustness.md): an operator
+    # should see at boot whether orphaned builds will resume or fail,
+    # and a typo'd LO_RESUME must refuse bring-up, never silently pick
+    # a side
+    print(
+        "resume config: "
+        f"enabled={sched_config.resume_enabled()} "
+        f"every_segments={sched_config.resume_every_segments()}",
+        flush=True,
+    )
+
     # ...and the zero-copy wire knobs (docs/dataplane.md): shm_bytes 0
     # means frames ride the HTTP body — an operator expecting the ring
     # should see that stated at boot, and a typo'd LO_DTYPE_POLICY

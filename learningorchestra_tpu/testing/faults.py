@@ -64,6 +64,30 @@ FAULT_POINTS = {
         "server-to-server call: peer health probe, follower WAL poll, "
         "quorum vote request (ctx: me, url, kind)"
     ),
+    # Compute-plane points (the crash-resume chaos harness,
+    # docs/robustness.md): where a kill proves segment checkpointing
+    # and resume-aware recovery, and an error proves the partial-results
+    # and per-member delivery contracts.
+    "builder.phase": (
+        "model builder, at a phase boundary of one classifier's "
+        "train (ctx: phase=load_data|preprocess|fit|checkpoint|"
+        "evaluate|write, classificator when per-classifier — a kill "
+        "here orphans the build mid-flight; an error fails one member)"
+    ),
+    "sched.journal.append": (
+        "job journal, before a lifecycle/progress document is inserted "
+        "(ctx: job, event — journal writes are best-effort, so an "
+        "error here loses an audit line, never the job)"
+    ),
+    "coalesce.dispatch": (
+        "job coalescer, before a fused batch dispatches (ctx: jobs — "
+        "an error here must become per-member failures, not a wedge)"
+    ),
+    "serve.forward": (
+        "serving batcher, before a request group's forward pass "
+        "(ctx: path, requests — an error here must become per-request "
+        "errors, not a dropped group)"
+    ),
 }
 
 _ACTIONS = ("kill", "delay", "error", "torn")

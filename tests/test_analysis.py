@@ -1,6 +1,6 @@
 """SPMD-safety + concurrency analyzer: per-rule fixtures, CLI, repo gate.
 
-Every rule family (LO101–LO104 SPMD safety, LO201–LO205 concurrency
+Every rule family (LO101–LO104 SPMD safety, LO201–LO206 concurrency
 hazards) gets at least one positive (bad code the rule must flag), one
 negative (the nearby good idiom it must NOT flag), and one suppressed
 fixture. The gate at the bottom runs the analyzer over the real source
@@ -1192,6 +1192,117 @@ class TestLO205TornPublish:
                         self._records[name] = task
         """
         assert rules_of(src) == set()
+
+
+# --------------------------------------------------------------------
+# LO206 — untimed HTTP / silent broad except on service edges
+# --------------------------------------------------------------------
+
+_SERVICE_PATH = "learningorchestra_tpu/services/probe.py"
+
+
+def service_rules_of(source: str) -> set:
+    return {
+        finding.rule
+        for finding in analyze_source(textwrap.dedent(source), _SERVICE_PATH)
+    }
+
+
+class TestLO206ServiceEdges:
+    def test_untimed_requests_call_flagged(self):
+        src = """
+            import requests
+
+            def probe(url):
+                return requests.get(url)
+        """
+        assert "LO206" in service_rules_of(src)
+
+    def test_untimed_urlopen_flagged(self):
+        src = """
+            from urllib.request import urlopen
+
+            def fetch(url):
+                return urlopen(url).read()
+        """
+        assert "LO206" in service_rules_of(src)
+
+    def test_timed_call_not_flagged(self):
+        src = """
+            import requests
+
+            def probe(url):
+                return requests.post(url, json={}, timeout=5)
+        """
+        assert "LO206" not in service_rules_of(src)
+
+    def test_silent_broad_except_flagged(self):
+        src = """
+            def probe(call):
+                try:
+                    call()
+                except Exception:
+                    pass
+        """
+        assert "LO206" in service_rules_of(src)
+
+    def test_bare_except_pass_flagged(self):
+        src = """
+            def probe(call):
+                try:
+                    call()
+                except:
+                    pass
+        """
+        assert "LO206" in service_rules_of(src)
+
+    def test_handled_broad_except_not_flagged(self):
+        # swallowing is the hazard, not breadth: a handler that records
+        # the failure is the documented best-effort idiom
+        src = """
+            import traceback
+
+            def probe(call):
+                try:
+                    call()
+                except Exception:
+                    traceback.print_exc()
+        """
+        assert "LO206" not in service_rules_of(src)
+
+    def test_client_module_in_scope(self):
+        src = """
+            import requests
+
+            def probe(url):
+                return requests.get(url)
+        """
+        findings = analyze_source(
+            textwrap.dedent(src), "learningorchestra_tpu/client.py"
+        )
+        assert "LO206" in {finding.rule for finding in findings}
+
+    def test_core_module_out_of_scope(self):
+        # path-gated: library/store code keeps its own error contracts
+        src = """
+            import requests
+
+            def probe(url):
+                return requests.get(url)
+        """
+        findings = analyze_source(
+            textwrap.dedent(src), "learningorchestra_tpu/core/probe.py"
+        )
+        assert "LO206" not in {finding.rule for finding in findings}
+
+    def test_inline_allow_comment_suppresses(self):
+        src = """
+            import requests
+
+            def probe(url):
+                return requests.get(url)  # lo: allow[LO206]
+        """
+        assert "LO206" not in service_rules_of(src)
 
 
 # --------------------------------------------------------------------

@@ -1,0 +1,339 @@
+"""The crash-resume chaos drill (docs/robustness.md): kill -9 a runner
+mid-5-classifier build, restart it on the same WAL, and prove the build
+reaches FINISHED with metrics equal to an uninterrupted run — the
+journal re-enqueued the orphaned job, the fits resumed from their
+progress artifacts (segments skipped, not re-run), and no acknowledged
+ingest row was lost.
+
+Slow by design (two full runner boots + six classifier fits); the fast
+unit halves of every claim here live in tests/test_resume.py.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_TESTS_DIR)
+
+CLASSIFIERS = ["lr", "dt", "rf", "gb", "nb"]
+
+PREPROCESSOR = (
+    "from pyspark.ml.feature import VectorAssembler\n"
+    "assembler = VectorAssembler(inputCols=['f1', 'f2'],"
+    " outputCol='features')\n"
+    "features_training = assembler.transform(training_df)\n"
+    "features_testing = assembler.transform(testing_df)\n"
+    "features_evaluation = features_training\n"
+)
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as error:
+        return error.code, error.read()
+
+
+def _get_json(url, timeout=30):
+    status, raw = _get(url, timeout)
+    return status, json.loads(raw)
+
+
+def _request(url, body, method="POST", timeout=60):
+    request = urllib.request.Request(
+        url,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(request, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class _Runner:
+    """One services.runner subprocess on ephemeral ports."""
+
+    def __init__(self, data_dir, models_dir, env_extra=None):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["PYTHONUNBUFFERED"] = "1"
+        env["LO_EPHEMERAL"] = "1"
+        env["LO_DATA_DIR"] = str(data_dir)
+        env["LO_MODELS_DIR"] = str(models_dir)
+        # one classifier at a time: the kill reliably lands while later
+        # members are still queued, maximizing the resumed run's work
+        env["LO_BUILD_WORKERS"] = "1"
+        env.update(env_extra or {})
+        self.process = subprocess.Popen(
+            [sys.executable, "-m", "learningorchestra_tpu.services.runner"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=_REPO_ROOT,
+        )
+        self.ports: dict[str, int] = {}
+        self.boot_lines: list[str] = []
+
+    def wait_serving(self, timeout_s=300) -> None:
+        deadline = time.time() + timeout_s
+        service_re = re.compile(r"service (\w+) on [\d.]+:(\d+)")
+        while time.time() < deadline:
+            line = self.process.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    "runner died during bring-up:\n"
+                    + "".join(self.boot_lines)
+                )
+            self.boot_lines.append(line)
+            match = service_re.search(line)
+            if match:
+                self.ports[match.group(1)] = int(match.group(2))
+            if "serving all services" in line:
+                return
+        raise AssertionError(
+            "runner never served:\n" + "".join(self.boot_lines)
+        )
+
+    def url(self, service: str, path: str) -> str:
+        return f"http://127.0.0.1:{self.ports[service]}{path}"
+
+    def kill9(self) -> int:
+        os.kill(self.process.pid, signal.SIGKILL)
+        return self.process.wait(timeout=30)
+
+    def terminate(self) -> None:
+        if self.process.poll() is None:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.process.kill()
+
+
+def _ingest(runner, name, csv_path, deadline_s=120) -> None:
+    status, _ = _request(
+        runner.url("database_api", "/files"),
+        {"filename": name, "url": str(csv_path)},
+    )
+    assert status == 201
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        status, body = _get_json(
+            runner.url(
+                "database_api", f"/files/{name}?skip=0&limit=1&query={{}}"
+            )
+        )
+        if status == 200 and body["result"][0].get("finished"):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"ingest of {name} never finished")
+    status, _ = _request(
+        runner.url("data_type_handler", f"/fieldtypes/{name}"),
+        {"f1": "number", "f2": "number", "label": "number"},
+        method="PATCH",
+    )
+    assert status == 200
+
+
+def _build(runner, name, classifiers, asynchronous=False, timeout=600):
+    body = {
+        "training_filename": name,
+        "test_filename": name,
+        "preprocessor_code": PREPROCESSOR,
+        "classificators_list": list(classifiers),
+    }
+    if asynchronous:
+        body["async"] = True
+    return _request(
+        runner.url("model_builder", "/models"), body, timeout=timeout
+    )
+
+
+def _prediction_metadata(runner, name, classifier):
+    status, body = _get_json(
+        runner.url(
+            "database_api",
+            f"/files/{name}_prediction_{classifier}"
+            "?skip=0&limit=1&query={}",
+        )
+    )
+    if status != 200 or not body.get("result"):
+        return None
+    metadata = body["result"][0]
+    return metadata if "accuracy" in metadata else None
+
+
+def _journal_has_segment_event(runner) -> bool:
+    skip = 0
+    while True:
+        status, body = _get_json(
+            runner.url(
+                "database_api",
+                f"/files/__lo_jobs__?skip={skip}&limit=20&query={{}}",
+            )
+        )
+        if status != 200:
+            return False
+        page = body.get("result") or []
+        if not page:
+            return False
+        if any(
+            doc.get("event") == "progress" and doc.get("kind") == "segment"
+            for doc in page
+        ):
+            return True
+        skip += len(page)
+
+
+def _metric_value(metrics_text: str, name: str) -> float:
+    total = 0.0
+    seen = False
+    for line in metrics_text.splitlines():
+        if line.startswith("#"):
+            continue
+        match = re.match(rf"^{re.escape(name)}(?:\{{.*\}})?\s+([\d.eE+-]+)$", line)
+        if match:
+            total += float(match.group(1))
+            seen = True
+    assert seen, f"{name} missing from /metrics"
+    return total
+
+
+@pytest.mark.slow
+@pytest.mark.integration
+def test_kill9_mid_build_resumes_to_identical_metrics(tmp_path):
+    data_dir = tmp_path / "lo_data"
+    models_dir = tmp_path / "models"
+    csv_path = tmp_path / "drill.csv"
+    with open(csv_path, "w") as f:
+        # features stay non-negative: NaiveBayes (the 5th classifier)
+        # enforces the MLlib non-negativity contract
+        f.write("f1,f2,label\n")
+        for i in range(120):
+            lab = i % 2
+            f.write(
+                f"{lab * 2 + (i % 7) * 0.1:.3f},"
+                f"{(1 - lab) * 2 + (i % 5) * 0.1:.3f},{lab}\n"
+            )
+
+    # Phase delays stretch every per-classifier phase boundary so the
+    # SIGKILL below reliably lands mid-build (never between builds),
+    # without changing any computed number.
+    first = _Runner(
+        data_dir,
+        models_dir,
+        env_extra={"LO_FAULT_BUILDER_PHASE": "delay:0.5@100"},
+    )
+    second = None
+    try:
+        first.wait_serving()
+        _ingest(first, "drill", csv_path)
+        status, body = _build(
+            first, "drill", CLASSIFIERS, asynchronous=True, timeout=30
+        )
+        assert status == 201
+        job_name = body["job"]
+        assert job_name == "build:drill:" + "+".join(CLASSIFIERS)
+
+        # the moment a fit-segment progress event is durably journaled,
+        # the build is provably mid-flight — pull the plug
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if _journal_has_segment_event(first):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("no segment progress event ever journaled")
+        returncode = first.kill9()
+        assert returncode == -signal.SIGKILL
+
+        # same WAL, same models volume, no faults: recovery must
+        # re-enqueue the orphaned build and finish it
+        second = _Runner(data_dir, models_dir)
+        second.wait_serving()
+        assert any(
+            "job recovery: 1 re-enqueued" in line
+            for line in second.boot_lines
+        ), "".join(second.boot_lines)
+
+        resumed: dict[str, dict] = {}
+        deadline = time.time() + 600
+        while time.time() < deadline and len(resumed) < len(CLASSIFIERS):
+            for name in CLASSIFIERS:
+                if name not in resumed:
+                    metadata = _prediction_metadata(second, "drill", name)
+                    if metadata is not None:
+                        resumed[name] = metadata
+            time.sleep(0.5)
+        assert sorted(resumed) == sorted(CLASSIFIERS), (
+            f"resumed build incomplete: {sorted(resumed)}"
+        )
+
+        # the resumed job itself reached FINISHED (not a fresh rebuild
+        # under another name): its record is queryable on the new runner
+        status, body = _get_json(
+            second.url("model_builder", f"/jobs/{job_name}")
+        )
+        assert status == 200
+        assert body["result"]["state"] == "finished"
+
+        # zero acknowledged ingest rows lost across the kill (the file
+        # read pages at 20 documents, reference parity — walk them all)
+        rows = []
+        skip = 0
+        while True:
+            status, body = _get_json(
+                second.url(
+                    "database_api",
+                    f"/files/drill?skip={skip}&limit=20&query={{}}",
+                )
+            )
+            assert status == 200
+            page = body["result"]
+            if not page:
+                break
+            rows.extend(d for d in page if d.get("_id", 0) != 0)
+            skip += len(page)
+        assert len(rows) == 120
+
+        # resume telemetry: the orphaned job was resumed (not replayed
+        # from scratch) and at least one fit segment was restored from
+        # a progress artifact instead of re-running
+        status, raw = _get(second.url("database_api", "/metrics"))
+        assert status == 200
+        metrics_text = raw.decode()
+        assert _metric_value(metrics_text, "lo_sched_resumed_total") >= 1
+        assert (
+            _metric_value(metrics_text, "lo_build_segments_skipped_total")
+            >= 1
+        )
+
+        # the headline: a control build of the same data on the healthy
+        # runner produces THE SAME metrics — resume changed wall-clock,
+        # never a number
+        _ingest(second, "drill_ctl", csv_path)
+        status, _ = _build(second, "drill_ctl", CLASSIFIERS, timeout=600)
+        assert status == 201
+        for name in CLASSIFIERS:
+            control = _prediction_metadata(second, "drill_ctl", name)
+            assert control is not None, f"control build missing {name}"
+            assert resumed[name]["accuracy"] == control["accuracy"], name
+            assert resumed[name].get("F1") == control.get("F1"), name
+    finally:
+        first.terminate()
+        if second is not None:
+            second.terminate()

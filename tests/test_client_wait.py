@@ -1,0 +1,194 @@
+"""Client-side resilience: the SDK's wait path across a service restart.
+
+The crash-resume tentpole makes the SERVER survive a kill -9 mid-build;
+these tests pin the CLIENT half of that story — a long-poll that dies
+with the server must reconnect (seeded-jitter backoff, one capability
+re-probe) and resolve against the restarted server, not hang or crash
+the caller. Pure unit tests: requests and time are scripted, no HTTP.
+"""
+
+import pytest
+import requests as requests_lib
+
+import learningorchestra_tpu.client as lo_client
+from learningorchestra_tpu.client import AsyncronousWait
+
+
+class _Response:
+    def __init__(self, status_code=200, body=None, headers=None):
+        self.status_code = status_code
+        self._body = body if body is not None else {}
+        self.headers = headers or {}
+
+    def json(self):
+        if isinstance(self._body, Exception):
+            raise self._body
+        return self._body
+
+
+def _health(job_wait=True):
+    return _Response(200, {"status": "ok", "job_wait": job_wait})
+
+
+def _terminal(state="finished"):
+    return _Response(200, {"result": {"state": state}})
+
+
+class _Script:
+    """Scripted requests.get: pops the next step; a step that is an
+    exception instance raises (the connection reset)."""
+
+    def __init__(self, steps):
+        self.steps = list(steps)
+        self.calls = []
+
+    def __call__(self, url, params=None, timeout=None, **kwargs):
+        self.calls.append({"url": url, "params": params, "timeout": timeout})
+        step = self.steps.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return step
+
+
+class _Reader:
+    url_base = "http://127.0.0.1:5000/files"
+
+    def _url(self, filename):
+        return f"{self.url_base}/{filename}"
+
+
+@pytest.fixture()
+def waiter(monkeypatch):
+    AsyncronousWait._push_probe_cache.clear()
+    sleeps = []
+    monkeypatch.setattr(lo_client.time, "sleep", sleeps.append)
+    instance = AsyncronousWait()
+    instance.recorded_sleeps = sleeps
+    yield instance
+    AsyncronousWait._push_probe_cache.clear()
+
+
+def _run_push(monkeypatch, waiter, steps):
+    script = _Script(steps)
+    monkeypatch.setattr(lo_client.requests, "get", script)
+    outcome = waiter._wait_push(_Reader(), "titanic_test")
+    return outcome, script
+
+
+class TestWaitPushReconnect:
+    def test_connection_reset_reconnects_and_resolves(
+        self, monkeypatch, waiter
+    ):
+        # park → reset (server killed) → re-probe health → park again →
+        # the RESUMED job finishes and resolves the wait
+        outcome, script = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                requests_lib.ConnectionError("peer reset"),
+                _health(job_wait=True),
+                _terminal("finished"),
+            ],
+        )
+        assert outcome is True
+        # backed off once, with a bounded delay
+        assert len(waiter.recorded_sleeps) == 1
+        assert 0 < waiter.recorded_sleeps[0] <= AsyncronousWait.MAX_WAIT_TIME
+        # call 2 was the health RE-probe (the cached capability was
+        # invalidated — the restarted server may be an older build)
+        assert script.calls[1]["url"].endswith("/health")
+        assert script.calls[2]["url"].endswith("/jobs/titanic_test/wait")
+
+    def test_restart_without_push_falls_back_to_polling(
+        self, monkeypatch, waiter
+    ):
+        outcome, script = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                requests_lib.ConnectionError("peer reset"),
+                _health(job_wait=False),
+            ],
+        )
+        assert outcome is False  # wait() then polls metadata
+
+    def test_unreachable_after_reset_falls_back(self, monkeypatch, waiter):
+        outcome, _ = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                requests_lib.ConnectionError("peer reset"),
+                requests_lib.ConnectionError("still down"),
+            ],
+        )
+        assert outcome is False
+
+    def test_repeated_resets_back_off_increasingly(self, monkeypatch, waiter):
+        outcome, _ = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                requests_lib.ConnectionError("reset 1"),
+                _health(job_wait=True),
+                requests_lib.ConnectionError("reset 2"),
+                _health(job_wait=True),
+                _terminal("failed"),
+            ],
+        )
+        assert outcome is True  # failed is terminal too: wait resolves
+        assert len(waiter.recorded_sleeps) == 2
+
+    def test_reconnect_resets_the_backoff_clock(self, monkeypatch, waiter):
+        # reset → reconnect → long-poll timeout (job alive) → reset
+        # again: attempt restarts at 1, so the second reset's delay is
+        # the FIRST-attempt delay again, not a deeper backoff
+        outcome, _ = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                requests_lib.ConnectionError("reset 1"),
+                _health(job_wait=True),
+                _Response(200, {"result": "timeout"}),
+                requests_lib.ConnectionError("reset 2"),
+                _health(job_wait=True),
+                _terminal(),
+            ],
+        )
+        assert outcome is True
+        assert waiter.recorded_sleeps[0] == waiter.recorded_sleeps[1]
+
+    def test_404_still_means_poll_fallback(self, monkeypatch, waiter):
+        outcome, _ = _run_push(
+            monkeypatch, waiter, [_Response(404, {"result": "not_found"})]
+        )
+        assert outcome is False
+
+    def test_429_honors_retry_after_without_reprobe(
+        self, monkeypatch, waiter
+    ):
+        outcome, script = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                _Response(429, {}, headers={"Retry-After": "0.2"}),
+                _terminal(),
+            ],
+        )
+        assert outcome is True
+        assert waiter.recorded_sleeps == [0.2]
+        # backpressure is not a restart: no health re-probe in between
+        assert all("/health" not in c["url"] for c in script.calls)
+
+    def test_every_request_carries_a_timeout(self, monkeypatch, waiter):
+        # LO206's contract, end to end: a wait that outlives a dead
+        # server by one socket timeout instead of forever
+        _, script = _run_push(
+            monkeypatch,
+            waiter,
+            [
+                requests_lib.ConnectionError("peer reset"),
+                _health(job_wait=True),
+                _terminal(),
+            ],
+        )
+        assert all(c["timeout"] is not None for c in script.calls)

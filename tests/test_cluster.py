@@ -726,6 +726,60 @@ class TestWebManifest:
             load({"wait_cap_s": 0})
 
 
+class TestResumeManifest:
+    def test_resume_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["resume"] = {"enabled": 1, "every_segments": 4}
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # recovery must agree on EVERY member
+            env = plan["env"]
+            assert env["LO_RESUME"] == "1"
+            assert env["LO_RESUME_EVERY_SEGMENTS"] == "4"
+
+    def test_resume_section_absent_sets_nothing(self, tmp_path):
+        # absent section = runner defaults; the driver must not pin the
+        # knobs to anything
+        cluster = _load_cluster_module()
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(_manifest()))
+        for plan in cluster.machine_plans(cluster.load_manifest(str(path))):
+            assert "LO_RESUME" not in plan["env"]
+            assert "LO_RESUME_EVERY_SEGMENTS" not in plan["env"]
+
+    def test_resume_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(resume):
+            manifest = _manifest()
+            manifest["resume"] = resume
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # enabled 0 = the pre-resume contract: valid
+        loaded = load({"enabled": 0, "every_segments": 1})
+        assert loaded["resume"]["enabled"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"enabled": 2})
+        with pytest.raises(SystemExit):
+            # bool-is-int trap: str(True) is "True", which the runner's
+            # strict 0/1 preflight would then refuse on every machine
+            load({"enabled": True})
+        with pytest.raises(SystemExit):
+            load({"enabled": "1"})
+        with pytest.raises(SystemExit):
+            load({"every_segments": 0})
+        with pytest.raises(SystemExit):
+            load({"every_segments": 1.5})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"every_segments": True})
+
+
 class TestMetricsScrape:
     def test_parse_prometheus_sums_families(self):
         cluster = _load_cluster_module()
