@@ -115,6 +115,12 @@ python - <<'EOF'
 import os
 from learningorchestra_tpu.sched import config
 config.host_width(); config.device_width(); config.queue_cap()
+# the rest of the scheduler table (docs/scheduler.md promises "all
+# knobs are validated at startup" — LO301 caught retries/backoff/
+# jitter/deadline/history validating nowhere)
+config.retry_budget(); config.backoff_base_s(); config.backoff_cap_s()
+config.jitter_seed(); config.default_timeout_s()
+config.job_history(); config.job_ttl_s()
 # coalescing knobs: window >= 0 (0 = passthrough), max_jobs a strict
 # integer >= 1 (1.5 silently truncating would halve every fused batch)
 config.coalesce_window_s(); config.coalesce_max_jobs()
@@ -141,7 +147,8 @@ lo_profile.validate_env()
 from learningorchestra_tpu.utils import webloop
 webloop.validate_env()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
-             "LO_STORE_SYNC_REPL", "LO_WIRE_V2"):
+             "LO_STORE_SYNC_REPL", "LO_WIRE_V2", "LO_SHAPE_BUCKETS",
+             "LO_EPHEMERAL", "LO_REPLICATE", "LO_STACK_EXIT_ON_STDIN_EOF"):
     value = os.environ.get(knob, "").strip()
     if value and value not in ("0", "1"):
         raise SystemExit(f"{knob} must be 0 or 1, got {value!r}")
@@ -154,8 +161,21 @@ for knob in ("LO_FOLLOWER_PORT", "LO_ARBITER_PORT"):
             port = -1
         if not 1 <= port <= 65535:
             raise SystemExit(f"{knob} must be a port number, got {value!r}")
+# service/store/coordinator ports additionally accept 0 = OS-assigned
+for knob in ("LO_PORT", "LO_STORE_PORT", "LO_COORD_PORT"):
+    value = os.environ.get(knob, "").strip()
+    if value:
+        try:
+            port = int(value)
+        except ValueError:
+            port = -1
+        if not 0 <= port <= 65535:
+            raise SystemExit(f"{knob} must be a port number, got {value!r}")
 for knob in ("LO_AUTO_PROMOTE_S", "LO_QUORUM_GRACE_S",
-             "LO_STORE_ACK_TIMEOUT_S"):
+             "LO_STORE_ACK_TIMEOUT_S", "LO_FAILOVER_TIMEOUT_S",
+             "LO_LANDED_OK_WINDOW_S", "LO_REPL_INTERVAL_S",
+             "LO_STORE_MONITOR_TICK_S", "LO_SPMD_HEARTBEAT_S",
+             "LO_METRICS_INTERVAL_S"):
     value = os.environ.get(knob, "").strip()
     if value:
         try:
@@ -164,6 +184,52 @@ for knob in ("LO_AUTO_PROMOTE_S", "LO_QUORUM_GRACE_S",
             seconds = -1.0
         if seconds <= 0:
             raise SystemExit(f"{knob} must be seconds > 0, got {value!r}")
+# 0 is meaningful here: no SPMD deadline / immediate supervisor restart
+for knob in ("LO_SPMD_TIMEOUT_S", "LO_RESTART_DELAY"):
+    value = os.environ.get(knob, "").strip()
+    if value:
+        try:
+            seconds = float(value)
+        except ValueError:
+            seconds = -1.0
+        if seconds < 0:
+            raise SystemExit(f"{knob} must be seconds >= 0, got {value!r}")
+# wire/build/process-topology counts: strictly integral with a floor —
+# a float or typo refuses bring-up instead of silently clamping
+for knob, floor in (("LO_WIRE_ROWS", 1), ("LO_WIRE_ROWS_BIN", 1),
+                    ("LO_COMPACT_RECORDS", 1), ("LO_BUILD_WORKERS", 1),
+                    ("LO_CHUNK_RETRIES", 0), ("LO_READ_RETRIES", 0),
+                    ("LO_WORKERS", 0), ("LO_TOTAL_PROCESSES", 0),
+                    ("LO_PROCESS_BASE", 0), ("LO_MAX_RESTARTS", 0)):
+    value = os.environ.get(knob, "").strip()
+    if value:
+        try:
+            count = int(value)
+        except ValueError:
+            count = floor - 1
+        if count < floor:
+            raise SystemExit(
+                f"{knob} must be an integer >= {floor}, got {value!r}")
+# byte budgets keep run.sh's 1e9 notation; 0 disables the feature
+for knob in ("LO_INGEST_SLAB_BYTES", "LO_SPILL_BYTES"):
+    value = os.environ.get(knob, "").strip()
+    if value:
+        try:
+            amount = int(float(value))
+        except ValueError:
+            amount = -1
+        if amount < 0:
+            raise SystemExit(
+                f"{knob} must be bytes >= 0 (1e9 notation ok), got {value!r}")
+value = os.environ.get("LO_PROGRAM_ROW_STEPS", "").strip()
+if value:
+    try:
+        scale = float(value)
+    except ValueError:
+        scale = -1.0
+    if scale <= 0:
+        raise SystemExit(
+            f"LO_PROGRAM_ROW_STEPS must be a scale > 0, got {value!r}")
 # crash-resume knobs: LO_RESUME strictly 0/1, checkpoint cadence a
 # strict integer >= 1 — "0.5" silently becoming "never checkpoint"
 # would void the whole crash-resume contract at the worst moment
@@ -183,13 +249,26 @@ EOF
 # lock-discipline invariants of the threaded serving stack (LO2xx) — a
 # bug found here costs seconds; found in production it costs a poisoned
 # runtime or a deadlocked lock and a supervisor restart.
+# The LO30x deployment-contract pass (docs/analysis.md) rides the same
+# invocation: knob/preflight/manifest/metric/fault-table parity over
+# the whole project, so the very validations above cannot drift from
+# the code that reads the knobs.
 # LO_ANALYSIS_WARN=1 downgrades to log-and-warn for emergency hotfixes;
 # LO_ANALYSIS_CHANGED=1 blocks only on findings NEW since the git
-# merge-base (forks and feature branches carrying an upstream backlog).
+# merge-base (forks and feature branches carrying an upstream backlog);
+# LO_ANALYSIS_FORMAT=json emits the machine-readable finding stream
+# (stable {rule, path, line, message, suppressed} objects) for CI
+# collectors while the human summary moves to stderr.
+analysis_flags=()
+if [ "${LO_ANALYSIS_FORMAT:-text}" = "json" ]; then
+    analysis_flags+=(--format=json)
+fi
 if [ "${LO_ANALYSIS_CHANGED:-0}" = "1" ]; then
-    python -m learningorchestra_tpu.analysis --changed learningorchestra_tpu
+    python -m learningorchestra_tpu.analysis "${analysis_flags[@]}" \
+        --changed learningorchestra_tpu
 else
-    python -m learningorchestra_tpu.analysis learningorchestra_tpu
+    python -m learningorchestra_tpu.analysis "${analysis_flags[@]}" \
+        learningorchestra_tpu
 fi
 
 exec python -m learningorchestra_tpu.services.runner

@@ -254,8 +254,10 @@ def main() -> int:
     data_dir = os.path.abspath(
         sys.argv[1]
         if len(sys.argv) > 1
+        # lo: allow[LO301] free-form path knob, no domain to preflight
         else os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     )
+    # lo: allow[LO301] free-form bind address, no domain to preflight
     host = os.environ.get("LO_HOST", "127.0.0.1")
     store_port = os.environ.get("LO_STORE_PORT", "27027")
     ephemeral = os.environ.get("LO_EPHEMERAL") == "1"

@@ -151,3 +151,50 @@ def base_findings(
         ):
             keys[finding.baseline_key(repo_root)] += 1
     return keys
+
+
+def base_project_keys(
+    select: set[str] | None, repo_root: str, base_sha: str
+) -> Counter:
+    """The merge-base's LO30x project-contract findings, keyed like a
+    baseline. The contract pass reads non-Python artifacts (run.sh,
+    docs tables), so blob-by-blob analysis is not enough: the base TREE
+    is materialized once via ``git archive`` into a tempdir and the
+    project pass runs there. Contract finding paths are root-relative,
+    so the keys collide with the current run's regardless of where the
+    tempdir lives."""
+    import io
+    import shutil
+    import tarfile
+    import tempfile
+
+    try:
+        result = subprocess.run(
+            ["git", "archive", "--format=tar", base_sha],
+            capture_output=True,
+            cwd=repo_root,
+            timeout=_GIT_TIMEOUT_S,
+        )
+    except FileNotFoundError:
+        raise ChangedModeError("--changed needs the `git` binary") from None
+    except subprocess.TimeoutExpired:
+        raise ChangedModeError("git archive timed out") from None
+    if result.returncode != 0:
+        raise ChangedModeError(
+            f"git archive failed: {result.stderr.decode().strip()}"
+        )
+    from learningorchestra_tpu.analysis.contracts import project_findings
+    from learningorchestra_tpu.analysis.registry import is_project_root
+
+    tmp_root = tempfile.mkdtemp(prefix="lo-analysis-base-")
+    try:
+        with tarfile.open(fileobj=io.BytesIO(result.stdout)) as archive:
+            archive.extractall(tmp_root, filter="data")
+        if not is_project_root(tmp_root):
+            return Counter()  # the base predates the contract artifacts
+        keys: Counter = Counter()
+        for finding in project_findings(tmp_root, select):
+            keys[finding.baseline_key(tmp_root)] += 1
+        return keys
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)

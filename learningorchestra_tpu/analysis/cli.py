@@ -10,8 +10,10 @@ finding is triaged.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+from dataclasses import replace
 
 from learningorchestra_tpu.analysis.baseline import (
     apply_baseline,
@@ -35,7 +37,10 @@ def _build_parser() -> argparse.ArgumentParser:
             "concurrency-hazard family: lock order (LO201), blocking "
             "calls under locks (LO202), unguarded shared state "
             "(LO203), condvar discipline (LO204), torn publishes "
-            "(LO205)."
+            "(LO205) — plus the deployment-contract family "
+            "(LO301-LO306): knob/preflight/manifest/metric/fault-table "
+            "parity across deploy/run.sh, deploy/cluster.py, the "
+            "telemetry registry, and the docs tables."
         ),
     )
     parser.add_argument(
@@ -85,6 +90,16 @@ def _build_parser() -> argparse.ArgumentParser:
         "--warn-only",
         action="store_true",
         help="report findings but always exit 0 (also: LO_ANALYSIS_WARN=1)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help=(
+            "output format: text (default) or json — a stable array of "
+            "{rule, path, line, message, suppressed} objects on stdout "
+            "(summaries move to stderr)"
+        ),
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
@@ -180,14 +195,40 @@ def main(argv: list[str] | None = None) -> int:
 
     findings = analyze_paths(args.paths, select)
 
-    if changed_root is not None:
-        from learningorchestra_tpu.analysis.changed import base_findings
+    # LO30x deployment-contract pass: runs once per project root the
+    # analyzed paths belong to (none found — a lone module, a fixture
+    # dir — means the contract rules simply have nothing to check)
+    from learningorchestra_tpu.analysis.contracts import (
+        find_project_root,
+        project_findings,
+    )
 
-        findings = apply_baseline(
-            findings,
-            base_findings(args.paths, select, changed_root, changed_base),
-            changed_root,
+    project_roots = sorted(
+        {
+            root
+            for root in (find_project_root(path) for path in args.paths)
+            if root is not None
+        }
+    )
+    for project_root in project_roots:
+        findings.extend(project_findings(project_root, select))
+
+    if changed_root is not None:
+        from learningorchestra_tpu.analysis.changed import (
+            base_findings,
+            base_project_keys,
         )
+
+        base_keys = base_findings(
+            args.paths, select, changed_root, changed_base
+        )
+        if os.path.realpath(changed_root) in {
+            os.path.realpath(root) for root in project_roots
+        }:
+            base_keys += base_project_keys(
+                select, changed_root, changed_base
+            )
+        findings = apply_baseline(findings, base_keys, changed_root)
 
     if args.write_baseline:
         write_baseline(baseline_path or DEFAULT_BASELINE, findings)
@@ -203,18 +244,61 @@ def main(argv: list[str] | None = None) -> int:
             baseline_root(baseline_path),
         )
 
-    for finding in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
-        print(finding.render())
+    def _display(finding):
+        # contract findings carry absolute paths (they are anchored at
+        # the project root, not at an argv path); show them relative to
+        # the CWD like every per-file finding the user asked about
+        if os.path.isabs(finding.path):
+            rel = os.path.relpath(finding.path)
+            if not rel.startswith(".."):
+                return replace(finding, path=rel)
+        return finding
+
+    findings = [
+        _display(finding)
+        for finding in sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule)
+        )
+    ]
     new = [finding for finding in findings if not finding.baselined]
+    summary_out = sys.stdout
+    if args.format == "json":
+        # stable machine-readable schema; the human summary moves to
+        # stderr so stdout parses as one JSON document
+        summary_out = sys.stderr
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": finding.rule,
+                        "path": finding.path,
+                        "line": finding.line,
+                        "message": finding.message,
+                        "suppressed": finding.baselined,
+                    }
+                    for finding in findings
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for finding in findings:
+            print(finding.render())
     if not findings:
-        print("analysis: clean")
+        print("analysis: clean", file=summary_out)
     elif not new:
-        print(f"analysis: {len(findings)} baselined finding(s), 0 new")
+        print(
+            f"analysis: {len(findings)} baselined finding(s), 0 new",
+            file=summary_out,
+        )
     else:
         print(
             f"analysis: {len(new)} new finding(s) "
-            f"({len(findings) - len(new)} baselined)"
+            f"({len(findings) - len(new)} baselined)",
+            file=summary_out,
         )
+    # the analyzer's own escape hatch, read at CLI invocation time
+    # lo: allow[LO301,LO305] — no preflight runs before the analyzer
     warn_env = os.environ.get("LO_ANALYSIS_WARN", "").strip().lower()
     # "=1 downgrades": an explicit 0/false/off must keep enforcement ON
     warn = args.warn_only or warn_env not in ("", "0", "false", "no", "off")

@@ -1,0 +1,318 @@
+"""LO301-LO306: the deployment-contract parity rules.
+
+The reference system's deployment contract was a pile of hand-wired
+env vars in docker-compose; this reproduction grew the same surface at
+10x the scale — every subsystem PR adds ``LO_*`` knobs that must be
+validated in ``deploy/run.sh``, plumbed by ``deploy/cluster.py``,
+documented in a ``docs/*.md`` knob table, and (for metrics and fault
+points) kept in lockstep with ``docs/observability.md`` and the docs
+fault tables. Until this family, that parity was reviewer discipline.
+
+These rules ride the same Finding/suppression/baseline machinery as
+LO1xx/LO2xx but run over the :mod:`registry` module's project-wide
+extraction pass instead of one module's AST:
+
+- **LO301** — a knob read in code with no ``run.sh`` preflight
+  validation, or validated there but read nowhere (dead validation).
+- **LO302** — a ``deploy/cluster.py`` manifest map plumbs an env name
+  no code reads (the spelling drifted from the code's).
+- **LO303** — a metric family declared but missing from
+  ``docs/observability.md``'s catalog, or a catalog row naming a
+  metric no code declares.
+- **LO304** — a ``testing/faults.py`` fault point without a docs
+  fault-table row, or a docs row naming an unregistered point.
+- **LO305** — an inline ``os.environ`` read outside config/boot
+  helpers (the read-once discipline: reads belong in
+  ``_int_env``-style helpers or ``validate_*`` accessors).
+- **LO306** — a knob read in code with no knob-table row in any
+  ``docs/*.md``.
+
+Suppression: knob-level findings (LO301/LO302/LO306) accept a
+``# lo: allow[LO30x]`` on ANY of the knob's read sites (or the line
+above), not just the anchor — the justification lives wherever the
+read is most at home. Site-level findings (LO305, doc rows, run.sh
+lines) suppress in place like every other rule; markdown rows take the
+comment as ``<!-- # lo: allow[LO303] -->``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+from learningorchestra_tpu.analysis.core import (
+    Finding,
+    SYNTAX_RULE,
+    _allowed_rules,
+)
+from learningorchestra_tpu.analysis.registry import (
+    ProjectRegistry,
+    build_registry,
+    fault_env_name,
+    find_project_root,
+)
+
+# Modules whose direct environ reads are boot wiring by definition:
+# deploy/*.py are launchers (they SET the env for everything else),
+# and config.py modules are the helpers themselves.
+_CONFIG_BASENAMES = ("config.py",)
+
+
+def _reads_for_contract(registry: ProjectRegistry):
+    """Knob -> read sites, minus the fault-injection grammar (LO304's
+    domain — ``LO_FAULT_*`` names are validated dynamically by
+    ``faults.validate_env`` and documented per point, not per knob)."""
+    return {
+        name: reads
+        for name, reads in registry.env_reads.items()
+        if not name.startswith("LO_FAULT_")
+    }
+
+
+# Each check yields (path, line, message, extra_sites): path/line
+# anchor the finding, extra_sites are additional (path, line) pairs an
+# allow comment may sit on (the knob's other read sites).
+
+
+def check_lo301(registry: ProjectRegistry) -> Iterator[tuple]:
+    if not registry.run_sh:
+        return
+    reads = _reads_for_contract(registry)
+    validated = registry.validated
+    for name in sorted(set(reads) - set(validated)):
+        sites = [(read.path, read.line) for read in reads[name]]
+        yield (
+            sites[0][0],
+            sites[0][1],
+            f"deployment knob {name} is read here but never validated by "
+            "the deploy/run.sh preflight (add a preflight check, or a "
+            "justified allow for boot-internal wiring)",
+            sites[1:],
+        )
+    for name in sorted(set(registry.validated_explicit) - set(reads)):
+        yield (
+            registry.run_sh,
+            registry.validated_explicit[name],
+            f"deployment knob {name} is validated by the deploy/run.sh "
+            "preflight but read nowhere in the tree (dead validation)",
+            [],
+        )
+
+
+def check_lo302(registry: ProjectRegistry) -> Iterator[tuple]:
+    reads = _reads_for_contract(registry)
+    seen: set[str] = set()
+    for knob in registry.manifest_knobs:
+        if knob.env in reads or knob.env in seen:
+            continue
+        seen.add(knob.env)
+        where = (
+            f"manifest key {knob.manifest_key!r}"
+            if knob.manifest_key
+            else "a manifest knob list"
+        )
+        yield (
+            knob.path,
+            knob.line,
+            f"deploy/cluster.py plumbs {knob.env} (via {where}) but no "
+            "code reads that env name — the manifest spelling has "
+            "drifted from the code's",
+            [],
+        )
+
+
+def check_lo303(registry: ProjectRegistry) -> Iterator[tuple]:
+    if not registry.doc_metrics and not registry.metrics:
+        return
+    for name in sorted(set(registry.metrics) - set(registry.doc_metrics)):
+        decl = registry.metrics[name]
+        yield (
+            decl.path,
+            decl.line,
+            f"metric family {name} ({decl.kind}) is declared here but has "
+            "no row in docs/observability.md's catalog",
+            [],
+        )
+    for name in sorted(set(registry.doc_metrics) - set(registry.metrics)):
+        row = registry.doc_metrics[name]
+        yield (
+            row.path,
+            row.line,
+            f"docs/observability.md documents metric {name} but no code "
+            "declares it (stale row, or a renamed family)",
+            [],
+        )
+
+
+def check_lo304(registry: ProjectRegistry) -> Iterator[tuple]:
+    declared = {
+        fault_env_name(point): (point, line)
+        for point, line in registry.fault_points.items()
+    }
+    for env in sorted(set(declared) - set(registry.doc_faults)):
+        point, line = declared[env]
+        yield (
+            registry.fault_points_path,
+            line,
+            f"fault point {point} ({env}) is registered in FAULT_POINTS "
+            "but has no docs fault-table row",
+            [],
+        )
+    for env in sorted(set(registry.doc_faults) - set(declared)):
+        row = registry.doc_faults[env]
+        yield (
+            row.path,
+            row.line,
+            f"docs fault table names {env} but testing/faults.py registers "
+            "no such fault point",
+            [],
+        )
+
+
+def check_lo305(registry: ProjectRegistry) -> Iterator[tuple]:
+    for name in sorted(registry.env_reads):
+        for read in registry.env_reads[name]:
+            if not read.direct or read.via_helper:
+                continue
+            if not read.path.startswith("learningorchestra_tpu/"):
+                continue  # deploy/*.py launchers set the env; boot code
+            if os.path.basename(read.path) in _CONFIG_BASENAMES:
+                continue
+            yield (
+                read.path,
+                read.line,
+                f"inline os.environ read of {name} outside a config "
+                "helper — centralize into a _int_env/_float_env-style "
+                "read-once helper (sched/config.py pattern) or justify "
+                "with an allow",
+                [],
+            )
+
+
+def check_lo306(registry: ProjectRegistry) -> Iterator[tuple]:
+    if not registry.doc_knobs:
+        return
+    reads = _reads_for_contract(registry)
+    for name in sorted(set(reads) - set(registry.doc_knobs)):
+        sites = [(read.path, read.line) for read in reads[name]]
+        yield (
+            sites[0][0],
+            sites[0][1],
+            f"deployment knob {name} is read here but has no knob-table "
+            "row in any docs/*.md",
+            sites[1:],
+        )
+
+
+# Registered into rules.RULES for --list-rules/--select/doc parity;
+# run_rules skips these ids — they run once per PROJECT, not per file.
+CONTRACT_RULES = {
+    "LO301": (
+        check_lo301,
+        "knob read in code but absent from the run.sh preflight "
+        "(or validated there but read nowhere)",
+    ),
+    "LO302": (
+        check_lo302,
+        "cluster-manifest knob whose env spelling no code reads",
+    ),
+    "LO303": (
+        check_lo303,
+        "metric family declared but undocumented in observability.md "
+        "(or documented but undeclared)",
+    ),
+    "LO304": (
+        check_lo304,
+        "fault point without a docs fault-table row (or vice versa)",
+    ),
+    "LO305": (
+        check_lo305,
+        "inline os.environ read outside config/boot helpers",
+    ),
+    "LO306": (
+        check_lo306,
+        "knob read in code with no docs knob-table row",
+    ),
+}
+
+PROJECT_RULE_IDS = frozenset(CONTRACT_RULES)
+
+
+class _LineCache:
+    def __init__(self, root: str):
+        self.root = root
+        self._cache: dict[str, list[str]] = {}
+
+    def lines(self, rel_path: str) -> list[str]:
+        cached = self._cache.get(rel_path)
+        if cached is None:
+            try:
+                with open(
+                    os.path.join(self.root, rel_path), encoding="utf-8"
+                ) as handle:
+                    cached = handle.read().splitlines()
+            except (OSError, UnicodeDecodeError):
+                cached = []
+            self._cache[rel_path] = cached
+        return cached
+
+
+def _site_allows(cache: _LineCache, rule: str, path: str, line: int) -> bool:
+    lines = cache.lines(path)
+    for lineno in (line, line - 1):
+        if 1 <= lineno <= len(lines):
+            allowed = _allowed_rules(lines[lineno - 1])
+            if rule in allowed or "*" in allowed:
+                return True
+    return False
+
+
+def project_findings(
+    root: str, select: set[str] | None = None
+) -> list[Finding]:
+    """Run the LO30x family over the project rooted at ``root``.
+
+    Returned finding paths are absolute (the CLI re-anchors for
+    display; baseline keys relativize against the analysis root);
+    suppression is resolved HERE against the artifact files, because
+    the per-file pipeline never sees run.sh or markdown sources."""
+    wanted = {
+        rule_id
+        for rule_id in CONTRACT_RULES
+        if select is None
+        or any(rule_id.startswith(token) for token in select)
+    }
+    if not wanted:
+        return []
+    registry = build_registry(root)
+    cache = _LineCache(root)
+    findings: list[Finding] = []
+    for problem in registry.problems:
+        findings.append(
+            Finding(
+                os.path.join(root, "deploy", "run.sh"),
+                1,
+                SYNTAX_RULE,
+                problem,
+            )
+        )
+    for rule_id in sorted(wanted):
+        check, _description = CONTRACT_RULES[rule_id]
+        for path, line, message, extra_sites in check(registry):
+            if any(
+                _site_allows(cache, rule_id, site_path, site_line)
+                for site_path, site_line in [(path, line), *extra_sites]
+            ):
+                continue
+            findings.append(
+                Finding(os.path.join(root, path), line, rule_id, message)
+            )
+    return findings
+
+
+__all__ = [
+    "CONTRACT_RULES",
+    "PROJECT_RULE_IDS",
+    "project_findings",
+    "find_project_root",
+]

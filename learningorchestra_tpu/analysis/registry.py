@@ -1,0 +1,675 @@
+"""Project-wide extraction pass: the cross-artifact contract registry.
+
+The LO1xx/LO2xx rules see one module at a time; the deployment
+contract (ISSUE: LO301-LO306, contracts.py) is a property of the whole
+tree plus its non-Python artifacts — the bash preflight, the cluster
+manifest plumbing, the docs tables. This module walks everything ONCE
+and builds plain-data registries the contract rules then compare:
+
+- every ``LO_*`` env name read in Python (``learningorchestra_tpu/``
+  and ``deploy/*.py``), with its reading module, line, enclosing
+  function, and whether the read flows through a config helper
+  (``_int_env``-style call, or a ``validate_*``/``*_env`` function);
+- every knob validated by ``deploy/run.sh``'s preflight, parsed from
+  the bash: the embedded ``python - <<'EOF'`` heredoc is valid Python,
+  so explicit ``LO_*`` string constants are read off its AST, and
+  validator calls (``config.host_width()``, ``webloop.validate_env()``)
+  resolve to knob sets through a per-module, per-function transitive
+  env-read map built from the same walk;
+- every manifest key -> env pair plumbed by ``deploy/cluster.py``'s
+  ``_*_KNOBS`` maps;
+- every ``lo_*`` metric family declared against the telemetry registry
+  (attribute calls, local ``_counter``-style wrappers, and f-string
+  names expanded through literal comprehension tuples);
+- every ``lo_*`` metric row in ``docs/observability.md`` (with the
+  catalog's ``\\`lo_x_hits\\` / \\`_misses\\``` suffix shorthand
+  expanded), every ``LO_*`` knob-table row across ``docs/*.md``, and
+  every fault-table row (point + ``LO_FAULT_*`` env pair);
+- every ``FAULT_POINTS`` entry in ``testing/faults.py``.
+
+Stdlib only, like the rest of the analysis package: the registry READS
+the tree, it never imports it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+# Directories (under the project root) whose Python participates in the
+# deployment contract. tests/ and learning_orchestra_client/ are
+# deliberately out: a knob only a test reads is a test fixture, not a
+# deployment surface.
+PY_SCOPE = ("learningorchestra_tpu", "deploy")
+
+_ENV_NAME_RE = re.compile(r"^LO_[A-Z0-9_]+$")
+_DOC_KNOB_ROW_RE = re.compile(r"\s*\|\s*`(LO_[A-Z0-9_*]+)")
+_DOC_FAULT_ROW_RE = re.compile(
+    r"\|\s*`([a-z][a-z0-9_.]*)`\s*\|\s*`(LO_FAULT_[A-Z0-9_]+)`"
+)
+_DOC_METRIC_CELL_RE = re.compile(
+    r"\s*\|\s*((?:`[a-z0-9_]+`)(?:\s*/\s*`[a-z0-9_]+`)*)\s*\|"
+)
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+@dataclass(frozen=True)
+class EnvRead:
+    """One ``LO_*`` env read site in Python."""
+
+    name: str  # the env var
+    path: str  # project-root-relative, '/'-separated
+    line: int
+    function: str  # innermost enclosing def name; "" at module level
+    direct: bool  # True = os.environ/getenv; False = *_env helper call
+
+    @property
+    def via_helper(self) -> bool:
+        """Does the read flow through a config helper — either a
+        ``_int_env``-style call, or code inside a ``validate_*`` /
+        ``*_env`` function (the validated-accessor pattern)?"""
+        if not self.direct:
+            return True
+        return self.function.startswith("validate_") or self.function.endswith(
+            "_env"
+        )
+
+
+@dataclass(frozen=True)
+class ManifestKnob:
+    """One env var plumbed by a ``deploy/cluster.py`` ``_*_KNOBS`` map."""
+
+    env: str
+    manifest_key: str  # "" for tuple-style (env-name-keyed) knob lists
+    path: str
+    line: int
+
+
+@dataclass(frozen=True)
+class MetricDecl:
+    """One ``lo_*`` metric family declaration site."""
+
+    name: str
+    kind: str  # counter | gauge | histogram
+    path: str
+    line: int
+
+
+@dataclass(frozen=True)
+class DocRow:
+    """One table row in docs/ naming a metric, knob, or fault point."""
+
+    name: str
+    path: str
+    line: int
+
+
+@dataclass
+class ProjectRegistry:
+    """Everything the LO30x parity rules compare, from one tree walk."""
+
+    root: str
+    env_reads: dict[str, list[EnvRead]] = field(default_factory=dict)
+    # knob -> run.sh line; explicit = LO_* string constants in the
+    # heredoc, resolved = knobs reached through validator calls
+    validated_explicit: dict[str, int] = field(default_factory=dict)
+    validated_resolved: dict[str, int] = field(default_factory=dict)
+    run_sh: str = ""  # root-relative path, "" when absent
+    manifest_knobs: list[ManifestKnob] = field(default_factory=list)
+    metrics: dict[str, MetricDecl] = field(default_factory=dict)
+    doc_metrics: dict[str, DocRow] = field(default_factory=dict)
+    doc_knobs: dict[str, list[DocRow]] = field(default_factory=dict)
+    doc_faults: dict[str, DocRow] = field(default_factory=dict)  # by env
+    fault_points: dict[str, int] = field(default_factory=dict)
+    fault_points_path: str = ""
+    problems: list[str] = field(default_factory=list)
+
+    @property
+    def validated(self) -> dict[str, int]:
+        merged = dict(self.validated_resolved)
+        merged.update(self.validated_explicit)
+        return merged
+
+
+def is_project_root(path: str) -> bool:
+    """A directory with the three artifacts the contract rules need."""
+    return (
+        os.path.isfile(os.path.join(path, "deploy", "run.sh"))
+        and os.path.isdir(os.path.join(path, "learningorchestra_tpu"))
+        and os.path.isdir(os.path.join(path, "docs"))
+    )
+
+
+def find_project_root(path: str) -> str | None:
+    """Walk ``path`` and its ancestors for the project root; None when
+    the analyzed tree is not a deployment-contract project (a lone
+    module, a fixture dir) — the LO30x pass then just doesn't run."""
+    probe = os.path.abspath(path)
+    if os.path.isfile(probe):
+        probe = os.path.dirname(probe)
+    while True:
+        if is_project_root(probe):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            return None
+        probe = parent
+
+
+# --------------------------------------------------------------------
+# Python walk: env reads + per-module function knob maps + metrics
+# --------------------------------------------------------------------
+
+
+def _iter_scope_files(root: str):
+    from learningorchestra_tpu.analysis.core import iter_python_files
+
+    scope = [
+        os.path.join(root, part)
+        for part in PY_SCOPE
+        if os.path.exists(os.path.join(root, part))
+    ]
+    yield from iter_python_files(scope)
+
+
+def _dotted(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """One module's env reads, call graph, and metric declarations."""
+
+    def __init__(self, rel_path: str):
+        self.rel_path = rel_path
+        self.stack: list[str] = []
+        self.reads: list[EnvRead] = []
+        # function name -> {knobs read directly inside it}
+        self.func_knobs: dict[str, set[str]] = {}
+        # function name -> {same-module function names it calls}
+        self.calls: dict[str, set[str]] = {}
+        self.defined: set[str] = set()
+        self.metrics: list[MetricDecl] = []
+        self._tree: ast.Module | None = None
+
+    # -- structure ----------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if not self.stack:
+            self.defined.add(node.name)
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _func(self) -> str:
+        return self.stack[-1] if self.stack else ""
+
+    def _record(self, name: str, line: int, direct: bool) -> None:
+        read = EnvRead(name, self.rel_path, line, self._func(), direct)
+        self.reads.append(read)
+        self.func_knobs.setdefault(self._func(), set()).add(name)
+
+    # -- env reads ----------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func_name = _dotted(node.func) or ""
+        last = func_name.rsplit(".", 1)[-1]
+        arg0 = node.args[0] if node.args else None
+        arg0_env = (
+            arg0.value
+            if isinstance(arg0, ast.Constant)
+            and isinstance(arg0.value, str)
+            and _ENV_NAME_RE.match(arg0.value)
+            else None
+        )
+        if arg0_env is not None:
+            base = ""
+            if isinstance(node.func, ast.Attribute):
+                base = _dotted(node.func.value) or ""
+            if last == "getenv" or (
+                base.endswith("environ")
+                and last in ("get", "pop", "setdefault")
+            ):
+                self._record(arg0_env, node.lineno, direct=True)
+            elif last != "getenv" and last.endswith("_env"):
+                # _int_env("LO_X", ...) — the config-helper pattern
+                self._record(arg0_env, node.lineno, direct=False)
+        # call graph (same-module Name calls only — enough to resolve
+        # validate_all()-style validators to their accessors)
+        if isinstance(node.func, ast.Name):
+            self.calls.setdefault(self._func(), set()).add(node.func.id)
+        # metric declarations: registry.counter("lo_..."), a local
+        # _counter("lo_...") wrapper, global_registry().counter(...),
+        # or an f-string name expanded through a literal comprehension
+        # tuple (core/devcache.py). The attr is read off the node, not
+        # the dotted chain — a chain rooted at a call has no dotted name
+        attr = ""
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+        elif isinstance(node.func, ast.Name):
+            attr = node.func.id
+        if attr.lstrip("_") in _METRIC_FACTORIES and arg0 is not None:
+            kind = attr.lstrip("_")
+            if (
+                isinstance(arg0, ast.Constant)
+                and isinstance(arg0.value, str)
+                and arg0.value.startswith("lo_")
+            ):
+                self.metrics.append(
+                    MetricDecl(arg0.value, kind, self.rel_path, node.lineno)
+                )
+            elif isinstance(arg0, ast.JoinedStr):
+                for name in self._expand_fstring(arg0):
+                    if name.startswith("lo_"):
+                        self.metrics.append(
+                            MetricDecl(name, kind, self.rel_path, node.lineno)
+                        )
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        base = _dotted(node.value) or ""
+        key = node.slice
+        if (
+            base.endswith("environ")
+            and isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+            and _ENV_NAME_RE.match(key.value)
+        ):
+            self._record(key.value, node.lineno, direct=True)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        # "LO_X" in os.environ — a presence check is a read
+        if (
+            len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.In, ast.NotIn))
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)
+            and _ENV_NAME_RE.match(node.left.value)
+            and (_dotted(node.comparators[0]) or "").endswith("environ")
+        ):
+            self._record(node.left.value, node.lineno, direct=True)
+        self.generic_visit(node)
+
+    # -- f-string metric names ----------------------------------------
+
+    def _expand_fstring(self, joined: ast.JoinedStr) -> list[str]:
+        """``f"lo_devcache_{name}"`` -> one name per value ``name``
+        takes in a literal comprehension iterable in this module. Only
+        all-Name placeholders with literal-tuple generators expand;
+        anything dynamic yields nothing (and the declared-vs-documented
+        rule surfaces the gap instead of guessing)."""
+        parts: list[list[str]] = []
+        for value in joined.values:
+            if isinstance(value, ast.Constant) and isinstance(
+                value.value, str
+            ):
+                parts.append([value.value])
+            elif isinstance(value, ast.FormattedValue) and isinstance(
+                value.value, ast.Name
+            ):
+                candidates = self._comprehension_values(value.value.id)
+                if not candidates:
+                    return []
+                parts.append(sorted(candidates))
+            else:
+                return []
+        names = [""]
+        for options in parts:
+            names = [prefix + opt for prefix in names for opt in options]
+        return names
+
+    def _comprehension_values(self, var: str) -> set[str]:
+        values: set[str] = set()
+        assert self._tree is not None
+        for node in ast.walk(self._tree):
+            if not isinstance(
+                node, (ast.DictComp, ast.ListComp, ast.SetComp, ast.GeneratorExp)
+            ):
+                continue
+            for gen in node.generators:
+                position = None
+                if isinstance(gen.target, ast.Name) and gen.target.id == var:
+                    position = -1  # bare element
+                elif isinstance(gen.target, ast.Tuple):
+                    for index, elt in enumerate(gen.target.elts):
+                        if isinstance(elt, ast.Name) and elt.id == var:
+                            position = index
+                if position is None or not isinstance(
+                    gen.iter, (ast.Tuple, ast.List)
+                ):
+                    continue
+                for elt in gen.iter.elts:
+                    if position == -1 and isinstance(elt, ast.Constant):
+                        if isinstance(elt.value, str):
+                            values.add(elt.value)
+                    elif (
+                        position >= 0
+                        and isinstance(elt, (ast.Tuple, ast.List))
+                        and len(elt.elts) > position
+                        and isinstance(elt.elts[position], ast.Constant)
+                        and isinstance(elt.elts[position].value, str)
+                    ):
+                        values.add(elt.elts[position].value)
+        return values
+
+    # -- closure ------------------------------------------------------
+
+    def knob_closure(self) -> dict[str, set[str]]:
+        """function -> every knob its (same-module-transitive) body
+        reads; how ``serve_config.validate_all()`` in the run.sh
+        heredoc resolves to the full serving knob set."""
+        closed = {name: set(knobs) for name, knobs in self.func_knobs.items()}
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in self.calls.items():
+                bucket = closed.setdefault(caller, set())
+                before = len(bucket)
+                for callee in callees:
+                    bucket |= closed.get(callee, set())
+                if len(bucket) != before:
+                    changed = True
+        return closed
+
+
+def _scan_module(abs_path: str, rel_path: str) -> _ModuleScan | None:
+    try:
+        with open(abs_path, encoding="utf-8") as handle:
+            source = handle.read()
+        tree = ast.parse(source, filename=rel_path)
+    except (OSError, UnicodeDecodeError, SyntaxError):
+        return None  # per-file rules already report these as LO000
+    scan = _ModuleScan(rel_path)
+    scan._tree = tree
+    scan.visit(tree)
+    return scan
+
+
+# --------------------------------------------------------------------
+# deploy/run.sh preflight
+# --------------------------------------------------------------------
+
+
+def _parse_run_sh(
+    root: str, module_knobs: dict[str, dict[str, set[str]]]
+) -> tuple[dict[str, int], dict[str, int], list[str]]:
+    """(explicit, resolved, problems) — knobs the preflight validates,
+    each with its run.sh line. ``module_knobs`` maps dotted module
+    names to that module's function->knobs closure."""
+    path = os.path.join(root, "deploy", "run.sh")
+    with open(path, encoding="utf-8") as handle:
+        lines = handle.read().splitlines()
+    heredoc_start = heredoc_end = None
+    for index, line in enumerate(lines):
+        if heredoc_start is None and re.match(r"python\d?\s+-\s+<<", line):
+            heredoc_start = index + 1
+        elif heredoc_start is not None and line.strip() == "EOF":
+            heredoc_end = index
+            break
+    if heredoc_start is None or heredoc_end is None:
+        return {}, {}, ["deploy/run.sh: no python heredoc preflight found"]
+    source = "\n".join(lines[heredoc_start:heredoc_end])
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as error:
+        return {}, {}, [f"deploy/run.sh: preflight heredoc: {error.msg}"]
+
+    def sh_line(node: ast.AST) -> int:
+        return heredoc_start + getattr(node, "lineno", 1)
+
+    aliases: dict[str, str] = {}
+    explicit: dict[str, int] = {}
+    resolved: dict[str, int] = {}
+    problems: list[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            for name in node.names:
+                aliases[name.asname or name.name] = (
+                    f"{node.module}.{name.name}"
+                )
+        elif isinstance(node, ast.Import):
+            for name in node.names:
+                aliases[name.asname or name.name] = name.name
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _ENV_NAME_RE.match(node.value):
+                explicit.setdefault(node.value, sh_line(node))
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            base = _dotted(node.func.value)
+            module = aliases.get(base or "")
+            if module is None:
+                continue
+            knobs = module_knobs.get(module, {}).get(node.func.attr)
+            if knobs is None:
+                if module.startswith("learningorchestra_tpu"):
+                    problems.append(
+                        f"deploy/run.sh: preflight calls {base}."
+                        f"{node.func.attr}() but {module} defines no such "
+                        "validator"
+                    )
+                continue
+            for knob in knobs:
+                resolved.setdefault(knob, sh_line(node))
+    return explicit, resolved, problems
+
+
+# --------------------------------------------------------------------
+# deploy/cluster.py manifest plumbing
+# --------------------------------------------------------------------
+
+
+def _parse_manifest_knobs(root: str) -> list[ManifestKnob]:
+    rel = "deploy/cluster.py"
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return []
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read(), filename=rel)
+    except (OSError, SyntaxError):
+        return []
+    knobs: list[ManifestKnob] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [
+            t.id
+            for t in node.targets
+            if isinstance(t, ast.Name) and t.id.endswith("_KNOBS")
+        ]
+        if not targets:
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            for key, val in zip(value.keys, value.values):
+                if (
+                    isinstance(key, ast.Constant)
+                    and isinstance(val, ast.Constant)
+                    and isinstance(val.value, str)
+                    and _ENV_NAME_RE.match(val.value)
+                ):
+                    knobs.append(
+                        ManifestKnob(
+                            val.value, str(key.value), rel, val.lineno
+                        )
+                    )
+        elif isinstance(value, (ast.Tuple, ast.List)):
+            for elt in value.elts:
+                if (
+                    isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)
+                    and _ENV_NAME_RE.match(elt.value)
+                ):
+                    knobs.append(ManifestKnob(elt.value, "", rel, elt.lineno))
+    return knobs
+
+
+# --------------------------------------------------------------------
+# docs tables
+# --------------------------------------------------------------------
+
+
+def _expand_metric_shorthand(names: list[str]) -> list[str]:
+    """``["lo_serve_registry_hits_total", "_misses_total"]`` — the
+    observability catalog's row shorthand — expands each ``_suffix`` by
+    replacing the same number of trailing segments of the first full
+    name."""
+    if not names:
+        return []
+    expanded = [names[0]]
+    head_segments = names[0].split("_")
+    for name in names[1:]:
+        if name.startswith("_"):
+            suffix_segments = name.lstrip("_").split("_")
+            expanded.append(
+                "_".join(
+                    head_segments[: -len(suffix_segments)] + suffix_segments
+                )
+            )
+        else:
+            expanded.append(name)
+    return expanded
+
+
+def _parse_docs(
+    root: str,
+) -> tuple[
+    dict[str, DocRow], dict[str, list[DocRow]], dict[str, DocRow]
+]:
+    doc_metrics: dict[str, DocRow] = {}
+    doc_knobs: dict[str, list[DocRow]] = {}
+    doc_faults: dict[str, DocRow] = {}
+    docs_dir = os.path.join(root, "docs")
+    for entry in sorted(os.listdir(docs_dir)):
+        if not entry.endswith(".md"):
+            continue
+        rel = f"docs/{entry}"
+        try:
+            lines = open(
+                os.path.join(docs_dir, entry), encoding="utf-8"
+            ).read().splitlines()
+        except (OSError, UnicodeDecodeError):
+            continue
+        for lineno, line in enumerate(lines, 1):
+            knob_match = _DOC_KNOB_ROW_RE.match(line)
+            if knob_match:
+                doc_knobs.setdefault(knob_match.group(1), []).append(
+                    DocRow(knob_match.group(1), rel, lineno)
+                )
+            fault_match = _DOC_FAULT_ROW_RE.search(line)
+            if fault_match:
+                doc_faults.setdefault(
+                    fault_match.group(2),
+                    DocRow(fault_match.group(2), rel, lineno),
+                )
+            if entry == "observability.md":
+                cell_match = _DOC_METRIC_CELL_RE.match(line)
+                if cell_match:
+                    raw = re.findall(r"`([a-z0-9_]+)`", cell_match.group(1))
+                    if raw and raw[0].startswith("lo_"):
+                        for name in _expand_metric_shorthand(raw):
+                            doc_metrics.setdefault(
+                                name, DocRow(name, rel, lineno)
+                            )
+    return doc_metrics, doc_knobs, doc_faults
+
+
+# --------------------------------------------------------------------
+# testing/faults.py
+# --------------------------------------------------------------------
+
+
+def _parse_fault_points(root: str) -> tuple[dict[str, int], str]:
+    rel = "learningorchestra_tpu/testing/faults.py"
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return {}, ""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read(), filename=rel)
+    except (OSError, SyntaxError):
+        return {}, rel
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                for t in node.targets
+            )
+            and isinstance(node.value, ast.Dict)
+        ):
+            return {
+                key.value: key.lineno
+                for key in node.value.keys
+                if isinstance(key, ast.Constant)
+                and isinstance(key.value, str)
+            }, rel
+    return {}, rel
+
+
+def fault_env_name(point: str) -> str:
+    """``store.wire.mutate`` -> ``LO_FAULT_STORE_WIRE_MUTATE`` — the
+    same mapping ``testing/faults.py`` applies."""
+    return "LO_FAULT_" + point.upper().replace(".", "_")
+
+
+# --------------------------------------------------------------------
+# the one entry point
+# --------------------------------------------------------------------
+
+
+def build_registry(root: str) -> ProjectRegistry:
+    """Walk the project once; every extraction failure lands in
+    ``registry.problems`` (surfaced as LO000 by the driver) instead of
+    raising — a half-parsed tree must degrade to fewer checks, not an
+    analyzer crash."""
+    root = os.path.abspath(root)
+    registry = ProjectRegistry(root=root)
+
+    module_knobs: dict[str, dict[str, set[str]]] = {}
+    for abs_path in _iter_scope_files(root):
+        rel = os.path.relpath(os.path.abspath(abs_path), root).replace(
+            os.sep, "/"
+        )
+        scan = _scan_module(abs_path, rel)
+        if scan is None:
+            continue
+        for read in scan.reads:
+            registry.env_reads.setdefault(read.name, []).append(read)
+        module = rel[:-3].replace("/", ".")
+        module_knobs[module] = scan.knob_closure()
+        if rel.startswith("learningorchestra_tpu/"):
+            for decl in scan.metrics:
+                registry.metrics.setdefault(decl.name, decl)
+    for reads in registry.env_reads.values():
+        reads.sort(key=lambda r: (r.path, r.line))
+
+    run_sh = os.path.join(root, "deploy", "run.sh")
+    if os.path.isfile(run_sh):
+        registry.run_sh = "deploy/run.sh"
+        try:
+            explicit, resolved, problems = _parse_run_sh(root, module_knobs)
+            registry.validated_explicit = explicit
+            registry.validated_resolved = resolved
+            registry.problems.extend(problems)
+        except (OSError, UnicodeDecodeError) as error:
+            registry.problems.append(f"deploy/run.sh: {error}")
+
+    registry.manifest_knobs = _parse_manifest_knobs(root)
+    (
+        registry.doc_metrics,
+        registry.doc_knobs,
+        registry.doc_faults,
+    ) = _parse_docs(root)
+    registry.fault_points, registry.fault_points_path = _parse_fault_points(
+        root
+    )
+    return registry

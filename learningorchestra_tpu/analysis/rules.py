@@ -901,6 +901,10 @@ def check_lo106(tree: ast.Module, path: str) -> Iterator[Finding]:
 from learningorchestra_tpu.analysis.concurrency import (  # noqa: E402
     CONCURRENCY_RULES,
 )
+from learningorchestra_tpu.analysis.contracts import (  # noqa: E402
+    CONTRACT_RULES,
+    PROJECT_RULE_IDS,
+)
 
 RULES = {
     "LO101": (
@@ -919,6 +923,7 @@ RULES = {
         "encode/decode hot path",
     ),
     **CONCURRENCY_RULES,
+    **CONTRACT_RULES,
 }
 
 # rules whose check takes (tree, path): the LO2xx family (lock registry
@@ -927,10 +932,15 @@ _PATH_RULES = set(CONCURRENCY_RULES) | {"LO106"}
 
 
 def run_rules(tree: ast.Module, path: str = "<string>") -> Iterator[Finding]:
-    """Every rule over one module. ``path`` feeds the LO2xx rules'
-    declared lock registry (cross-module lock ranks are keyed by module
-    path) and LO106's core/ scope gate; the LO1xx checks ignore it."""
+    """Every per-FILE rule over one module. ``path`` feeds the LO2xx
+    rules' declared lock registry (cross-module lock ranks are keyed by
+    module path) and LO106's core/ scope gate; the LO1xx checks ignore
+    it. The LO30x contract rules are registered in RULES (for
+    --list-rules / --select / doc parity) but run once per project via
+    contracts.project_findings, not here."""
     for rule_id, (check, _description) in RULES.items():
+        if rule_id in PROJECT_RULE_IDS:
+            continue
         if rule_id in _PATH_RULES:
             yield from check(tree, path)
         else:

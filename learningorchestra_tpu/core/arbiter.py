@@ -132,7 +132,9 @@ def main() -> None:
         faults.validate_env()  # refuse bring-up on a typo'd chaos knob
     except ValueError as error:
         raise SystemExit(f"LO_FAULT_* validation failed: {error}")
+    # lo: allow[LO305] boot main(): the arbiter's own launcher wiring
     host = os.environ.get("LO_HOST", "127.0.0.1")
+    # lo: allow[LO305] boot main(): the arbiter's own launcher wiring
     port = int(os.environ.get("LO_ARBITER_PORT", DEFAULT_ARBITER_PORT))
     server = serve(host, port)
     print(f"store arbiter on {host}:{server.port}", flush=True)

@@ -70,6 +70,7 @@ def capacity_bytes() -> int:
     """``LO_DEVCACHE_BYTES`` validated (deploy/run.sh preflights this):
     total bytes of cached payloads, host and device entries against one
     budget. ``0`` disables caching entirely."""
+    # lo: allow[LO305] this IS the validated accessor preflight calls
     raw = os.environ.get("LO_DEVCACHE_BYTES", "").strip()
     if not raw:
         return DEFAULT_CAPACITY_BYTES

@@ -37,6 +37,7 @@ BATCH_SIZE = 4096
 # bytes + cell index in anonymous RAM — ~2x file size — which a 12 GB
 # CSV cannot afford on an out-of-core store). 0 disables slabbing.
 _SLAB_BYTES = int(
+    # lo: allow[LO305] module-level read-once by design (see above)
     float(os.environ.get("LO_INGEST_SLAB_BYTES", "536870912") or 0)
 )
 

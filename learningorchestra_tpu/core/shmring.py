@@ -89,6 +89,7 @@ def shm_bytes() -> int:
     """``LO_SHM_BYTES`` validated: ring segment size in bytes, ``1e9``
     notation accepted (like ``LO_DEVCACHE_BYTES``); ``0`` (the default)
     disables the shared-memory transport entirely."""
+    # lo: allow[LO305] this IS the validated accessor preflight calls
     raw = os.environ.get("LO_SHM_BYTES", "").strip()
     if not raw:
         return 0

@@ -642,7 +642,9 @@ class InMemoryStore(DocumentStore):
         # 0 disables); past it, cold blocks move to disk-backed
         # mappings under LO_SPILL_DIR (default <data_dir>/spill, or a
         # temp dir for pure in-memory stores). See _maybe_spill_locked.
+        # lo: allow[LO305] per-store state, frozen at construction
         self._spill_budget = float(os.environ.get("LO_SPILL_BYTES", "8e9") or 0)
+        # lo: allow[LO301,LO305] free-form path knob, no numeric domain
         explicit_spill_dir = os.environ.get("LO_SPILL_DIR")
         if explicit_spill_dir:
             # an operator-chosen directory may be shared between stores

@@ -143,6 +143,47 @@ from learningorchestra_tpu.utils.web import (
 DEFAULT_STORE_PORT = 27027
 
 
+# Deployment-knob readers (sched/config.py pattern): every LO_* env
+# read in this module funnels through these so the knob surface stays
+# greppable and the contract analyzer (LO305) can verify the
+# read-once discipline. The deploy/run.sh preflight validates the
+# numeric domains before any service boots; an unset/empty value
+# means "use the default" at every call site below.
+
+
+def _str_env(name: str, default: str | None = None) -> str | None:
+    return os.environ.get(name, default)
+
+
+def _int_env(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError as error:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from error
+
+
+def _float_env(name: str, default: float | None) -> float | None:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError as error:
+        raise ValueError(f"{name} must be a number, got {raw!r}") from error
+
+
+def _flag_env(name: str, default: bool = False) -> bool:
+    """Strict 0/1 flags (the domain deploy/run.sh's preflight
+    enforces): unset/empty -> ``default``, else ``raw == "1"``."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    return raw == "1"
+
+
 class StoreUnavailableError(PermissionError):
     """The store rejected or cannot currently accept a write — a
     read-only follower's 503, a quorum-suspended primary's 503 +
@@ -738,19 +779,19 @@ class RemoteStore(DocumentStore):
         self.failover_timeout = (
             failover_timeout
             if failover_timeout is not None
-            else float(os.environ.get("LO_FAILOVER_TIMEOUT_S", "30"))
+            else _float_env("LO_FAILOVER_TIMEOUT_S", 30.0)
         )
         self.timeout = timeout
         # Rows per read_columns wire chunk (LO_WIRE_ROWS): bounds every
         # JSON body the data plane ships, mirroring the write batching
         # in core/table.py insert_columns_batched.
         self.wire_rows = max(
-            1, wire_rows or int(os.environ.get("LO_WIRE_ROWS", "100000"))
+            1, wire_rows or _int_env("LO_WIRE_ROWS", 100000)
         )
         # Rows per binary-frame chunk: typed buffers are ~10× denser
         # than JSON, so the binary plane pages in much larger strides.
         self.wire_rows_bin = max(
-            1, int(os.environ.get("LO_WIRE_ROWS_BIN", "2000000"))
+            1, _int_env("LO_WIRE_ROWS_BIN", 2000000)
         )
         # LO_STORE_COMPRESS=1: zlib the binary frames both ways (the
         # client advertises on reads, stamps its uploads) — worth it on
@@ -758,7 +799,7 @@ class RemoteStore(DocumentStore):
         # default where the store is co-located and CPU is the scarcer
         # resource.
         self.compress = (
-            os.environ.get("LO_STORE_COMPRESS", "0") == "1"
+            _flag_env("LO_STORE_COMPRESS")
             if compress is None
             else compress
         )
@@ -766,14 +807,14 @@ class RemoteStore(DocumentStore):
         # whole read surfaces the error (the stream resumes at the
         # failed chunk, never from chunk 0).
         self.chunk_retries = max(
-            0, int(os.environ.get("LO_CHUNK_RETRIES", "2"))
+            0, _int_env("LO_CHUNK_RETRIES", 2)
         )
         # LO_WIRE_V2=0 is the escape hatch back to v1 frames (the
         # default advertises v2 on reads and, once /health confirms a
         # bin2 server, uploads v2 too — old servers just keep talking
         # v1, negotiated per request through X-Lo-Columns-Accept).
         self.wire_v2 = (
-            os.environ.get("LO_WIRE_V2", "1") != "0"
+            _flag_env("LO_WIRE_V2", default=True)
             if wire_v2 is None
             else wire_v2
         )
@@ -802,9 +843,7 @@ class RemoteStore(DocumentStore):
         # writes that DID land, and used to abort a fully durable
         # ingest with a KeyError (ADVICE r5).
         self._ambiguous_marks: dict[str, float] = {}
-        self.landed_ok_window_s = float(
-            os.environ.get("LO_LANDED_OK_WINDOW_S", "600")
-        )
+        self.landed_ok_window_s = _float_env("LO_LANDED_OK_WINDOW_S", 600.0)
         # Lazily-built read-ahead pool: chunk N+1's network fetch
         # overlaps chunk N's decode (+ inflate). Per-STORE and
         # persistent so the helper threads' requests.Sessions survive
@@ -1465,7 +1504,7 @@ class RemoteStore(DocumentStore):
         3) torn attempts the last result is returned best-effort, which
         matches the reference's own read semantics (Mongo cursors don't
         snapshot either)."""
-        retries = int(os.environ.get("LO_READ_RETRIES", "3"))
+        retries = _int_env("LO_READ_RETRIES", 3)
         for _ in range(max(retries, 1)):
             out, torn = self._read_column_arrays_once(
                 collection, fields, start, limit, check_rev=True
@@ -1706,10 +1745,11 @@ def connect(url: Optional[str] = None) -> DocumentStore:
     ``DATABASE_URL``; a comma-separated list names the replica pair and
     enables client-side failover), else a process-local WAL-backed
     store."""
-    url = url if url is not None else os.environ.get("LO_STORE_URL")
+    # lo: allow[LO301] free-form URL knob, no domain to preflight
+    url = url if url is not None else _str_env("LO_STORE_URL")
     if url:
         return RemoteStore(url)
-    data_dir = os.environ.get("LO_DATA_DIR")
+    data_dir = _str_env("LO_DATA_DIR")
     return InMemoryStore(data_dir=data_dir)
 
 
@@ -1735,7 +1775,7 @@ class ReplicationClient:
         self.interval = (
             interval
             if interval is not None
-            else float(os.environ.get("LO_REPL_INTERVAL_S", "0.5"))
+            else _float_env("LO_REPL_INTERVAL_S", 0.5)
         )
         self.batch = batch
         # identifies this node at the store.net fault point so chaos
@@ -2034,9 +2074,9 @@ def serve(
     import secrets
 
     if sync_repl is None:
-        sync_repl = os.environ.get("LO_STORE_SYNC_REPL", "0") == "1"
+        sync_repl = _flag_env("LO_STORE_SYNC_REPL")
     if ack_timeout_s is None:
-        ack_timeout_s = float(os.environ.get("LO_STORE_ACK_TIMEOUT_S", "2.0"))
+        ack_timeout_s = _float_env("LO_STORE_ACK_TIMEOUT_S", 2.0)
     role = {
         "writable": writable,
         "poller": None,
@@ -2105,13 +2145,11 @@ def serve(
     tick = (
         monitor_tick_s
         if monitor_tick_s is not None
-        else float(os.environ.get("LO_STORE_MONITOR_TICK_S", "1.0"))
+        else _float_env("LO_STORE_MONITOR_TICK_S", 1.0)
     )
     if quorum_grace_s is None:
-        grace_env = os.environ.get("LO_QUORUM_GRACE_S")
-        if grace_env:
-            quorum_grace_s = float(grace_env)
-        else:
+        quorum_grace_s = _float_env("LO_QUORUM_GRACE_S", None)
+        if quorum_grace_s is None:
             # a primary must suspend BEFORE the majority side can have
             # promoted, or a short dual-primary window opens: default
             # the grace under the takeover timer
@@ -2346,7 +2384,7 @@ def serve(
         # followers, on a follower compaction is purely local (the
         # poller's cursor tracks the PRIMARY's epoch, not the local
         # one), and a follower promoted later keeps compacting.
-        threshold = int(os.environ.get("LO_COMPACT_RECORDS", "200000"))
+        threshold = _int_env("LO_COMPACT_RECORDS", 200000)
         stop = threading.Event()
 
         def maintain():
@@ -2366,19 +2404,20 @@ def main() -> None:
         faults.validate_env()
     except ValueError as error:
         raise SystemExit(f"LO_FAULT_* validation failed: {error}")
-    host = os.environ.get("LO_HOST", "127.0.0.1")
-    port = int(os.environ.get("LO_STORE_PORT", DEFAULT_STORE_PORT))
-    data_dir = os.environ.get("LO_DATA_DIR")
-    replicate = os.environ.get("LO_REPLICATE") == "1"
-    primary_url = os.environ.get("LO_PRIMARY_URL")
-    peers_env = os.environ.get("LO_PEERS", "")
+    host = _str_env("LO_HOST", "127.0.0.1")
+    port = _int_env("LO_STORE_PORT", DEFAULT_STORE_PORT)
+    data_dir = _str_env("LO_DATA_DIR")
+    replicate = _flag_env("LO_REPLICATE")
+    # free-form topology strings (URLs, host lists, node ids): nothing
+    # for the run.sh preflight to range-check
+    primary_url = _str_env("LO_PRIMARY_URL")  # lo: allow[LO301]
+    peers_env = _str_env("LO_PEERS", "")  # lo: allow[LO301]
     peers = [p.strip() for p in peers_env.split(",") if p.strip()] or None
-    arbiters_env = os.environ.get("LO_ARBITERS", "")
+    arbiters_env = _str_env("LO_ARBITERS", "")  # lo: allow[LO301]
     arbiters = [
         a.strip() for a in arbiters_env.split(",") if a.strip()
     ] or None
-    auto_env = os.environ.get("LO_AUTO_PROMOTE_S")
-    auto_promote_s = float(auto_env) if auto_env else None
+    auto_promote_s = _float_env("LO_AUTO_PROMOTE_S", None)
     server = serve(
         host,
         port,
@@ -2388,7 +2427,7 @@ def main() -> None:
         peers,
         auto_promote_s,
         arbiters=arbiters,
-        node_id=os.environ.get("LO_NODE_ID"),
+        node_id=_str_env("LO_NODE_ID"),  # lo: allow[LO301] free-form
     )
     mode = (
         f"follower of {primary_url}"

@@ -39,11 +39,13 @@ def resolve_mesh(mesh: Optional[Mesh]) -> Mesh:
 # be set identically on every host (deploy/README.md env contract).
 try:
     _PROGRAM_BUDGET_SCALE = float(
+        # lo: allow[LO305] module-level read-once by design (see above)
         os.environ.get("LO_PROGRAM_ROW_STEPS", "1") or "1"
     )
 except ValueError as error:
     raise ValueError(
         "LO_PROGRAM_ROW_STEPS must be a number, got "
+        # lo: allow[LO305] error-message echo of the same knob
         f"{os.environ.get('LO_PROGRAM_ROW_STEPS')!r}"
     ) from error
 

@@ -281,7 +281,9 @@ def train_one(
     # the (collective) checkpoint gather runs is decided identically
     # everywhere — a per-host env fallback on "" would desynchronize.
     if models_dir is None:
-        models_dir = os.environ.get("LO_MODELS_DIR")
+        # free-form volume path: no numeric domain to preflight, and
+        # lo: allow[LO305] — read here so every process resolves one
+        models_dir = os.environ.get("LO_MODELS_DIR")  # lo: allow[LO301]
     if models_dir:
         from learningorchestra_tpu.ml.checkpoint import (
             checkpoint_path,
@@ -563,6 +565,7 @@ def build_model(
         max_workers = 1
     else:
         max_workers = len(classificators_list) or 1
+        # lo: allow[LO305] validated in place with its own error below
         cap = os.environ.get("LO_BUILD_WORKERS", "").strip()
         if cap:
             try:
@@ -580,6 +583,7 @@ def build_model(
     # Coordinator-only (write_outputs), like every other host-side
     # artifact (parallel/spmd.py:19-21): worker processes run the same
     # compute but must not write to the trace volume.
+    # lo: allow[LO301,LO305] free-form profile-dir path, per-build read
     trace_root = os.environ.get("LO_TRACE_DIR")
     trace_dir = None
     tracing = (
@@ -598,6 +602,7 @@ def build_model(
     # host only: a resumed in-process build cannot rejoin a multi-host
     # collective stream, so workers never persist progress.
     if models_dir is None:
+        # lo: allow[LO305] same env fallback the sink and train_one use
         models_dir = os.environ.get("LO_MODELS_DIR")
     make_sink: Optional[Callable] = None
     if (
@@ -684,7 +689,10 @@ def _build_model_traced(
     # writes): coordinator-only host work — the writer thread touches
     # the store, never the device, so it cannot reorder SPMD dispatch.
     overlap = (
-        write_outputs and os.environ.get("LO_WRITE_OVERLAP", "1") != "0"
+        # lo: allow[LO305] — per-build read: a mid-flight flip only
+        # affects the NEXT build, never a writer already draining
+        write_outputs
+        and os.environ.get("LO_WRITE_OVERLAP", "1") != "0"  # lo: allow[LO305]
     )
     writer = PredictionWriter() if overlap else None
     resume_done = resume_done or {}

@@ -167,6 +167,7 @@ class SpmdDispatcher:
         if jax.process_count() == 1 or jax.process_index() != 0:
             return
         if interval is None:
+            # lo: allow[LO305] deliberate per-start read (test knob)
             interval = float(os.environ.get("LO_SPMD_HEARTBEAT_S", "10"))
 
         def beat() -> None:
@@ -228,6 +229,7 @@ class SpmdDispatcher:
             self._observe(op, "ok", started)
             return result
         if timeout is None:
+            # lo: allow[LO305] deliberate per-dispatch read (test knob)
             timeout = float(os.environ.get("LO_SPMD_TIMEOUT_S", "3600") or 0)
         # deliberate lock-free fast path: _poisoned is a monotonic
         # latch (None -> reason, never back), so a stale read here only

@@ -96,6 +96,7 @@ def create_app(
     # uniquified job name — so "two SPMD dispatches never contend for
     # the mesh" holds even for the parity path.
     duplicate_seq = itertools.count(1)
+    # lo: allow[LO305] app-factory boot wiring, same fallback as runner
     models_dir = models_dir or os.environ.get("LO_MODELS_DIR")
     jobs = jobs or JobManager()
     # the coalescing stage (sched/coalesce.py): process-wide by default

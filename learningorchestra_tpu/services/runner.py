@@ -120,6 +120,36 @@ from learningorchestra_tpu.ml.checkpoint import checkpoint_path as _ckpt
 from learningorchestra_tpu.utils.web import ServerThread
 
 
+# Deployment-knob readers (sched/config.py pattern): the runner's LO_*
+# env reads funnel through these so the boot surface stays greppable
+# and the contract analyzer (LO305) can verify the read-once
+# discipline. deploy/run.sh's preflight validates the numeric domains
+# before boot; unset/empty means "use the default".
+
+
+def _str_env(name: str, default: str | None = None) -> str | None:
+    return os.environ.get(name, default)
+
+
+def _int_env(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError as error:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from error
+
+
+def _flag_env(name: str, default: bool = False) -> bool:
+    """Strict 0/1 flags (the domain deploy/run.sh's preflight
+    enforces): unset/empty -> ``default``, else ``raw == "1"``."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    return raw == "1"
+
+
 SERVICES: dict[str, int] = {
     "database_api": DATABASE_API_PORT,
     "projection": PROJECTION_PORT,
@@ -212,7 +242,7 @@ def build_app(
     if name == "model_builder":
         # Opt-in (LO_MODELS_DIR / models_dir): library and test callers
         # of start_all don't silently grow a checkpoint directory.
-        models_dir = models_dir or os.environ.get("LO_MODELS_DIR", "")
+        models_dir = models_dir or _str_env("LO_MODELS_DIR", "")
         build = None
         predict = None
         if dispatcher is not None:
@@ -335,9 +365,11 @@ def main() -> None:
     # join as the same process_id.
     print(
         "runner starting: "
-        f"LO_SERVICE={os.environ.get('LO_SERVICE')!r} "
-        f"LO_COORDINATOR={os.environ.get('LO_COORDINATOR')!r} "
-        f"LO_PROCESS_ID={os.environ.get('LO_PROCESS_ID')!r} "
+        # boot banner; name-set knobs checked by runner/multihost at
+        # boot, not range-checkable by the preflight
+        f"LO_SERVICE={_str_env('LO_SERVICE')!r} "  # lo: allow[LO301]
+        f"LO_COORDINATOR={_str_env('LO_COORDINATOR')!r} "  # lo: allow[LO301]
+        f"LO_PROCESS_ID={_str_env('LO_PROCESS_ID')!r} "  # lo: allow[LO301]
         f"JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r}",
         flush=True,
     )
@@ -388,7 +420,7 @@ def main() -> None:
     print(
         f"wire config: shm_bytes={shmring.shm_bytes()} "
         f"dtype_policy={dtype_policy()} "
-        f"v2={os.environ.get('LO_WIRE_V2', '1') != '0'}",
+        f"v2={_flag_env('LO_WIRE_V2', default=True)}",
         flush=True,
     )
 
@@ -400,19 +432,20 @@ def main() -> None:
 
     print(f"web config: {webloop.validate_env()}", flush=True)
 
-    data_dir = os.environ.get("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
+    data_dir = _str_env("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache
 
     enable_compile_cache(os.path.join(data_dir, "jit_cache"))  # data_dir may predate env read
-    images_dir = os.environ.get(
+    # lo: allow[LO301] free-form volume path, no domain to preflight
+    images_dir = _str_env(
         "LO_IMAGES_DIR", os.path.join(data_dir, "images")
     )
-    models_dir = os.environ.get(
+    models_dir = _str_env(
         "LO_MODELS_DIR", os.path.join(data_dir, "models")
     )
-    host = os.environ.get("LO_HOST", "127.0.0.1")
-    store_url = os.environ.get("LO_STORE_URL")
-    service = os.environ.get("LO_SERVICE")
+    host = _str_env("LO_HOST", "127.0.0.1")
+    store_url = _str_env("LO_STORE_URL")
+    service = _str_env("LO_SERVICE")
 
     if store_url:
         store = connect(store_url)
@@ -433,7 +466,7 @@ def main() -> None:
                 "must share one store server "
                 "(python -m learningorchestra_tpu.core.store_service)"
             )
-        if os.environ.get("LO_MODELS_DIR") is None:
+        if _str_env("LO_MODELS_DIR") is None:
             # Same reasoning for checkpoints: predict-from-checkpoint
             # broadcasts the artifact path to every process, so the
             # models dir must be a volume all hosts mount — not each
@@ -478,7 +511,7 @@ def main() -> None:
         )
 
     if service:
-        port = int(os.environ.get("LO_PORT", SERVICES[service]))
+        port = _int_env("LO_PORT", SERVICES[service])
         server = ServerThread(
             build_app(service, store, images_dir, dispatcher, models_dir, jobs),
             host,
@@ -492,7 +525,7 @@ def main() -> None:
             store,
             images_dir,
             host,
-            ephemeral=os.environ.get("LO_EPHEMERAL") == "1",
+            ephemeral=_flag_env("LO_EPHEMERAL"),
             dispatcher=dispatcher,
             models_dir=models_dir,
             jobs=jobs,

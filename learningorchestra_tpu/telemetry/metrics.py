@@ -248,6 +248,16 @@ class MetricsRegistry:
             name, help_text, "histogram", labels, buckets=tuple(buckets)
         )
 
+    def declared_families(self) -> dict[str, str]:
+        """Snapshot of ``{family name: kind}`` for every declared
+        metric — the introspection surface the deployment-contract
+        analyzer (analysis/contracts.py, LO303) and its anti-rot test
+        compare against ``docs/observability.md``'s catalog."""
+        with self._lock:
+            return {
+                name: metric.kind for name, metric in self._metrics.items()
+            }
+
     def register_collector(
         self, collector: Callable[["MetricsRegistry"], None]
     ) -> None:

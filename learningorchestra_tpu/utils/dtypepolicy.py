@@ -47,6 +47,7 @@ def dtype_policy() -> str:
     rides device-cache keys."""
     if not _POLICY:
         _POLICY.append(
+            # lo: allow[LO305] read-once accessor, validated in place
             validate_policy(os.environ.get("LO_DTYPE_POLICY", "f32"))
         )
     return _POLICY[0]

@@ -114,10 +114,12 @@ def enable_compile_cache(default_dir: str | None = None) -> str | None:
     _register_listeners()  # count hits/misses even on repeat calls
     if _ACTIVE_DIR is not None:
         return _ACTIVE_DIR
+    # lo: allow[LO301,LO305] free-form cache-dir path, read once here
     cache_dir = os.environ.get("LO_JIT_CACHE")
     if cache_dir is None:
         cache_dir = default_dir
     if cache_dir is None:
+        # lo: allow[LO305] same data-dir fallback the runner resolves
         data_dir = os.environ.get(
             "LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data")
         )

@@ -36,6 +36,7 @@ import numpy as np
 # consulted (rows, micro-batches above their floor, coalesced job
 # axes). Read once: per-request reads could desynchronize padded shapes
 # — and so dispatch counts — across the hosts of a multi-host mesh.
+# lo: allow[LO305] module-level read-once by design (see above)
 _BUCKETS_ENABLED = os.environ.get("LO_SHAPE_BUCKETS", "1") != "0"
 
 

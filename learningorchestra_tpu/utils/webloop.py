@@ -63,6 +63,7 @@ from learningorchestra_tpu.telemetry import metrics as _metrics
 
 
 def web_async_enabled() -> bool:
+    # lo: allow[LO305] this IS the validated accessor preflight calls
     raw = os.environ.get("LO_WEB_ASYNC", "").strip()
     if raw not in ("", "0", "1"):
         raise ValueError(f"LO_WEB_ASYNC must be 0 or 1, got {raw!r}")

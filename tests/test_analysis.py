@@ -10,7 +10,10 @@ analyzer exists to enforce on every PR.
 
 from __future__ import annotations
 
+import json
 import os
+import re
+import shutil
 import subprocess
 import sys
 import textwrap
@@ -1782,6 +1785,756 @@ class TestChangedMode:
         )
         assert (
             cli_main(["--changed", "--write-baseline", "legacy.py"]) == 2
+        )
+
+
+# --------------------------------------------------------------------
+# LO301–LO306 — the deployment-contract family (project-level pass)
+# --------------------------------------------------------------------
+
+
+def _write_project(base) -> None:
+    """A minimal-but-complete deployment-contract project: one knob
+    validated explicitly in the run.sh heredoc (LO_GOOD_KNOB), one
+    through a validator call (LO_TICK_S via conf.tick_s), a manifest
+    map, one metric family, one fault point, and docs rows for all of
+    it. ``project_findings`` over it is CLEAN; each rule's test breaks
+    exactly one seam."""
+    pkg = base / "learningorchestra_tpu"
+    (pkg / "testing").mkdir(parents=True)
+    (base / "deploy").mkdir()
+    (base / "docs").mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "testing" / "__init__.py").write_text("")
+    (pkg / "conf.py").write_text(
+        textwrap.dedent(
+            """\
+            import os
+
+
+            def _float_env(name, default):
+                raw = os.environ.get(name, "").strip()
+                return float(raw) if raw else default
+
+
+            def tick_s():
+                return _float_env("LO_TICK_S", 1.0)
+            """
+        )
+    )
+    (pkg / "mod.py").write_text(
+        textwrap.dedent(
+            """\
+            import os
+
+
+            def _int_env(name, default):
+                raw = os.environ.get(name, "").strip()
+                return int(raw) if raw else default
+
+
+            def width():
+                return _int_env("LO_GOOD_KNOB", 8)
+
+
+            def declare(registry):
+                registry.counter("lo_good_total")
+            """
+        )
+    )
+    (pkg / "testing" / "faults.py").write_text(
+        textwrap.dedent(
+            """\
+            FAULT_POINTS = {
+                "store.wire": "before a mutation applies",
+            }
+            """
+        )
+    )
+    (base / "deploy" / "cluster.py").write_text(
+        textwrap.dedent(
+            """\
+            SERVE_KNOBS = {
+                "width": "LO_GOOD_KNOB",
+            }
+            """
+        )
+    )
+    (base / "deploy" / "run.sh").write_text(
+        textwrap.dedent(
+            """\
+            #!/usr/bin/env bash
+            set -euo pipefail
+            python - <<'EOF'
+            import os
+            from learningorchestra_tpu import conf
+
+            value = os.environ.get("LO_GOOD_KNOB", "")
+            if value and int(value) < 1:
+                raise SystemExit("LO_GOOD_KNOB must be >= 1")
+            conf.tick_s()
+            EOF
+            """
+        )
+    )
+    (base / "docs" / "usage.md").write_text(
+        textwrap.dedent(
+            """\
+            # Usage
+
+            | env var | default | meaning |
+            |---|---|---|
+            | `LO_GOOD_KNOB` | `8` | worker width |
+            | `LO_TICK_S` | `1.0` | monitor tick |
+            """
+        )
+    )
+    (base / "docs" / "observability.md").write_text(
+        textwrap.dedent(
+            """\
+            # Observability
+
+            | family | kind | meaning |
+            |---|---|---|
+            | `lo_good_total` | counter | good events |
+            """
+        )
+    )
+    (base / "docs" / "robustness.md").write_text(
+        textwrap.dedent(
+            """\
+            # Robustness
+
+            | point | env | where |
+            |---|---|---|
+            | `store.wire` | `LO_FAULT_STORE_WIRE` | before a mutation applies |
+            """
+        )
+    )
+
+
+def _project_findings(base, select=None):
+    from learningorchestra_tpu.analysis.contracts import project_findings
+
+    return project_findings(str(base), select)
+
+
+def _append(path, text) -> None:
+    with open(path, "a", encoding="utf-8") as handle:
+        handle.write(textwrap.dedent(text))
+
+
+class TestContractProjectPass:
+    def test_clean_project_is_clean(self, tmp_path):
+        _write_project(tmp_path)
+        assert _project_findings(tmp_path) == []
+
+    def test_non_project_dir_has_no_contract_pass(self, tmp_path):
+        from learningorchestra_tpu.analysis.contracts import (
+            find_project_root,
+        )
+
+        (tmp_path / "lone.py").write_text("def fn():\n    return 1\n")
+        assert find_project_root(str(tmp_path / "lone.py")) is None
+
+    def test_find_project_root_from_nested_path(self, tmp_path):
+        from learningorchestra_tpu.analysis.contracts import (
+            find_project_root,
+        )
+
+        _write_project(tmp_path)
+        nested = tmp_path / "learningorchestra_tpu" / "mod.py"
+        assert find_project_root(str(nested)) == str(tmp_path)
+
+
+class TestLO301PreflightParity:
+    def test_unvalidated_read_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def depth():
+                return _int_env("LO_ORPHAN_KNOB", 2)
+            """,
+        )
+        findings = _project_findings(tmp_path, {"LO301"})
+        assert len(findings) == 1
+        assert findings[0].rule == "LO301"
+        assert "LO_ORPHAN_KNOB" in findings[0].message
+        assert findings[0].path.endswith("mod.py")
+
+    def test_dead_validation_flagged_at_run_sh(self, tmp_path):
+        _write_project(tmp_path)
+        run_sh = tmp_path / "deploy" / "run.sh"
+        run_sh.write_text(
+            run_sh.read_text().replace(
+                "conf.tick_s()",
+                'conf.tick_s()\nos.environ.get("LO_DEAD", "")',
+            )
+        )
+        findings = _project_findings(tmp_path, {"LO301"})
+        assert len(findings) == 1
+        assert "LO_DEAD" in findings[0].message
+        assert "dead validation" in findings[0].message
+        assert findings[0].path.endswith("run.sh")
+
+    def test_validator_call_counts_as_validation(self, tmp_path):
+        # LO_TICK_S is validated only through conf.tick_s() in the
+        # heredoc — the clean fixture proves call-resolution works
+        _write_project(tmp_path)
+        assert _project_findings(tmp_path, {"LO301"}) == []
+
+    def test_allow_on_any_read_site_suppresses(self, tmp_path):
+        _write_project(tmp_path)
+        # two read sites: anchor lands in conf.py (sorts first), the
+        # allow lives at the OTHER site in mod.py
+        _append(
+            tmp_path / "learningorchestra_tpu" / "conf.py",
+            """
+            def orphan_a():
+                return _float_env("LO_ORPHAN_KNOB", 0.0)
+            """,
+        )
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def orphan_b():
+                # lo: allow[LO301] test fixture justification
+                return _int_env("LO_ORPHAN_KNOB", 2)
+            """,
+        )
+        assert _project_findings(tmp_path, {"LO301"}) == []
+
+
+class TestLO302ManifestParity:
+    def test_unread_manifest_env_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "deploy" / "cluster.py",
+            """
+            STALE_KNOBS = {
+                "stale": "LO_STALE",
+            }
+            """,
+        )
+        findings = _project_findings(tmp_path, {"LO302"})
+        assert len(findings) == 1
+        assert "LO_STALE" in findings[0].message
+        assert findings[0].path.endswith("cluster.py")
+
+    def test_allow_on_manifest_line_suppresses(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "deploy" / "cluster.py",
+            """
+            STALE_KNOBS = {
+                "stale": "LO_STALE",  # lo: allow[LO302] staged rollout
+            }
+            """,
+        )
+        assert _project_findings(tmp_path, {"LO302"}) == []
+
+
+class TestLO303MetricParity:
+    def test_declared_but_undocumented_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def declare_more(registry):
+                registry.gauge("lo_orphan_rows")
+            """,
+        )
+        findings = _project_findings(tmp_path, {"LO303"})
+        assert len(findings) == 1
+        assert "lo_orphan_rows" in findings[0].message
+        assert "gauge" in findings[0].message
+
+    def test_documented_but_undeclared_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "docs" / "observability.md",
+            "| `lo_ghost_total` | counter | gone |\n",
+        )
+        findings = _project_findings(tmp_path, {"LO303"})
+        assert len(findings) == 1
+        assert "lo_ghost_total" in findings[0].message
+        assert findings[0].path.endswith("observability.md")
+
+    def test_markdown_allow_comment_suppresses(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "docs" / "observability.md",
+            "| `lo_ghost_total` | counter | gone |"
+            " <!-- # lo: allow[LO303] retired family -->\n",
+        )
+        assert _project_findings(tmp_path, {"LO303"}) == []
+
+
+class TestLO304FaultTableParity:
+    def test_unregistered_docs_row_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "docs" / "robustness.md",
+            "| `store.nope` | `LO_FAULT_STORE_NOPE` | nowhere |\n",
+        )
+        findings = _project_findings(tmp_path, {"LO304"})
+        assert len(findings) == 1
+        assert "LO_FAULT_STORE_NOPE" in findings[0].message
+
+    def test_undocumented_fault_point_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        faults = (
+            tmp_path / "learningorchestra_tpu" / "testing" / "faults.py"
+        )
+        faults.write_text(
+            faults.read_text().replace(
+                '"store.wire": "before a mutation applies",',
+                '"store.wire": "before a mutation applies",\n'
+                '    "store.extra": "undocumented",',
+            )
+        )
+        findings = _project_findings(tmp_path, {"LO304"})
+        assert len(findings) == 1
+        assert "store.extra" in findings[0].message
+        assert findings[0].path.endswith("faults.py")
+
+    def test_allow_on_fault_point_line_suppresses(self, tmp_path):
+        _write_project(tmp_path)
+        faults = (
+            tmp_path / "learningorchestra_tpu" / "testing" / "faults.py"
+        )
+        faults.write_text(
+            faults.read_text().replace(
+                '"store.wire": "before a mutation applies",',
+                '"store.wire": "before a mutation applies",\n'
+                '    # lo: allow[LO304] docs row lands in the next PR\n'
+                '    "store.extra": "undocumented",',
+            )
+        )
+        assert _project_findings(tmp_path, {"LO304"}) == []
+
+
+class TestLO305InlineEnvReads:
+    def test_direct_read_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def inline():
+                return os.environ.get("LO_GOOD_KNOB", "")
+            """,
+        )
+        findings = _project_findings(tmp_path, {"LO305"})
+        assert len(findings) == 1
+        assert findings[0].rule == "LO305"
+        assert "LO_GOOD_KNOB" in findings[0].message
+
+    def test_helper_reads_not_flagged(self, tmp_path):
+        _write_project(tmp_path)  # every fixture read is via *_env
+        assert _project_findings(tmp_path, {"LO305"}) == []
+
+    def test_config_module_exempt(self, tmp_path):
+        _write_project(tmp_path)
+        (tmp_path / "learningorchestra_tpu" / "config.py").write_text(
+            "import os\n"
+            "READ_ONCE = os.environ.get('LO_GOOD_KNOB', '')\n"
+        )
+        assert _project_findings(tmp_path, {"LO305"}) == []
+
+    def test_deploy_launchers_exempt(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "deploy" / "cluster.py",
+            """
+            import os
+
+
+            def launch():
+                return os.environ.get("LO_GOOD_KNOB", "")
+            """,
+        )
+        assert _project_findings(tmp_path, {"LO305"}) == []
+
+    def test_validate_function_exempt(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def validate_width():
+                return os.environ.get("LO_GOOD_KNOB", "")
+            """,
+        )
+        assert _project_findings(tmp_path, {"LO305"}) == []
+
+    def test_inline_allow_comment_suppresses(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def inline():
+                # lo: allow[LO305] test fixture justification
+                return os.environ.get("LO_GOOD_KNOB", "")
+            """,
+        )
+        assert _project_findings(tmp_path, {"LO305"}) == []
+
+
+class TestLO306DocsParity:
+    def test_undocumented_knob_flagged(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def hidden():
+                return _int_env("LO_UNDOC", 1)
+            """,
+        )
+        findings = _project_findings(tmp_path, {"LO306"})
+        assert len(findings) == 1
+        assert "LO_UNDOC" in findings[0].message
+
+    def test_fault_knobs_are_lo304s_domain(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def chaos():
+                return os.environ.get("LO_FAULT_STORE_WIRE", "")
+            """,
+        )
+        # documented per point (LO304), never per knob — and the read
+        # is direct, so only LO305 would apply to the site
+        assert _project_findings(tmp_path, {"LO306"}) == []
+        assert _project_findings(tmp_path, {"LO301"}) == []
+
+    def test_allow_at_read_site_suppresses(self, tmp_path):
+        _write_project(tmp_path)
+        _append(
+            tmp_path / "learningorchestra_tpu" / "mod.py",
+            """
+            def hidden():
+                # lo: allow[LO306] internal-only knob
+                return _int_env("LO_UNDOC", 1)
+            """,
+        )
+        assert _project_findings(tmp_path, {"LO306"}) == []
+
+
+# rule id -> mutation of the clean synthetic project that must make
+# the CLI fail with exactly that contract rule
+_BREAK_BY_RULE = {
+    "LO301": lambda base: _append(
+        base / "learningorchestra_tpu" / "mod.py",
+        "\ndef depth():\n    return _int_env('LO_ORPHAN_KNOB', 2)\n",
+    ),
+    "LO302": lambda base: _append(
+        base / "deploy" / "cluster.py",
+        "\nSTALE_KNOBS = {'stale': 'LO_STALE'}\n",
+    ),
+    "LO303": lambda base: _append(
+        base / "docs" / "observability.md",
+        "| `lo_ghost_total` | counter | gone |\n",
+    ),
+    "LO304": lambda base: _append(
+        base / "docs" / "robustness.md",
+        "| `store.nope` | `LO_FAULT_STORE_NOPE` | nowhere |\n",
+    ),
+    "LO305": lambda base: _append(
+        base / "learningorchestra_tpu" / "mod.py",
+        "\ndef inline():\n"
+        "    return os.environ.get('LO_GOOD_KNOB', '')\n",
+    ),
+    "LO306": lambda base: _append(
+        base / "learningorchestra_tpu" / "mod.py",
+        "\ndef hidden():\n    return _int_env('LO_UNDOC', 1)\n",
+    ),
+}
+
+
+class TestContractCli:
+    @pytest.mark.parametrize("rule", sorted(_BREAK_BY_RULE))
+    def test_each_contract_rule_fails_the_cli(
+        self, rule, tmp_path, capsys
+    ):
+        _write_project(tmp_path)
+        _BREAK_BY_RULE[rule](tmp_path)
+        assert cli_main([str(tmp_path), "--select", rule]) == 1
+        assert rule in capsys.readouterr().out
+
+    def test_clean_project_through_cli(self, tmp_path, capsys):
+        _write_project(tmp_path)
+        assert cli_main([str(tmp_path)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_select_lo3_prefix_runs_the_family(self, tmp_path, capsys):
+        _write_project(tmp_path)
+        _BREAK_BY_RULE["LO306"](tmp_path)
+        assert cli_main([str(tmp_path), "--select", "LO3"]) == 1
+        assert "LO306" in capsys.readouterr().out
+
+    def test_select_other_family_skips_project_pass(self, tmp_path):
+        _write_project(tmp_path)
+        _BREAK_BY_RULE["LO306"](tmp_path)
+        assert cli_main([str(tmp_path), "--select", "LO101"]) == 0
+
+    def test_broken_run_sh_surfaces_as_lo000(self, tmp_path, capsys):
+        _write_project(tmp_path)
+        (tmp_path / "deploy" / "run.sh").write_text(
+            "#!/usr/bin/env bash\npython - <<'EOF'\ndef broken(:\nEOF\n"
+        )
+        assert cli_main([str(tmp_path)]) == 1
+        assert "LO000" in capsys.readouterr().out
+
+    def test_format_json_schema(self, tmp_path, capsys):
+        _write_project(tmp_path)
+        _BREAK_BY_RULE["LO306"](tmp_path)
+        assert cli_main([str(tmp_path), "--format=json"]) == 1
+        captured = capsys.readouterr()
+        payload = json.loads(captured.out)
+        # the undocumented knob is also unvalidated: LO301 rides along
+        assert sorted(f["rule"] for f in payload) == ["LO301", "LO306"]
+        for entry in payload:
+            assert set(entry) == {
+                "rule",
+                "path",
+                "line",
+                "message",
+                "suppressed",
+            }
+            assert entry["suppressed"] is False
+        # the human summary moves to stderr so stdout parses whole
+        assert "finding" in captured.err
+
+    def test_format_json_clean_is_empty_array(self, tmp_path, capsys):
+        _write_project(tmp_path)
+        assert cli_main([str(tmp_path), "--format=json"]) == 0
+        captured = capsys.readouterr()
+        assert json.loads(captured.out) == []
+        assert "clean" in captured.err
+
+    def test_contract_baseline_round_trip(self, tmp_path, capsys):
+        """Grandfather a contract finding, see it baselined (and
+        marked suppressed in json), fix it, regenerate empty."""
+        _write_project(tmp_path)
+        _BREAK_BY_RULE["LO302"](tmp_path)
+        baseline = tmp_path / "baseline.txt"
+        assert (
+            cli_main(
+                [str(tmp_path), "--write-baseline", "--baseline",
+                 str(baseline)]
+            )
+            == 0
+        )
+        capsys.readouterr()
+        assert (
+            cli_main([str(tmp_path), "--baseline", str(baseline)]) == 0
+        )
+        assert "baselined" in capsys.readouterr().out
+        assert (
+            cli_main(
+                [str(tmp_path), "--baseline", str(baseline),
+                 "--format=json"]
+            )
+            == 0
+        )
+        payload = json.loads(capsys.readouterr().out)
+        assert [f["suppressed"] for f in payload] == [True]
+        # fix the drift; the regenerated baseline ends EMPTY — the
+        # shipped tree's contract (ISSUE 16: end-empty sweep)
+        (tmp_path / "deploy" / "cluster.py").write_text(
+            "SERVE_KNOBS = {\n    'width': 'LO_GOOD_KNOB',\n}\n"
+        )
+        assert (
+            cli_main(
+                [str(tmp_path), "--write-baseline", "--baseline",
+                 str(baseline)]
+            )
+            == 0
+        )
+        body = [
+            line
+            for line in baseline.read_text().splitlines()
+            if line.strip() and not line.startswith("#")
+        ]
+        assert body == []
+
+
+class TestContractChangedMode:
+    def test_merge_base_contract_findings_grandfathered(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        def git(*args):
+            subprocess.run(
+                ["git", *args],
+                cwd=tmp_path,
+                check=True,
+                capture_output=True,
+                env={
+                    **os.environ,
+                    "GIT_AUTHOR_NAME": "t",
+                    "GIT_AUTHOR_EMAIL": "t@t",
+                    "GIT_COMMITTER_NAME": "t",
+                    "GIT_COMMITTER_EMAIL": "t@t",
+                },
+            )
+
+        _write_project(tmp_path)
+        _BREAK_BY_RULE["LO306"](tmp_path)  # pre-existing drift
+        git("init", "-b", "main")
+        git("add", "-A")
+        git("commit", "-m", "seed")
+        git("checkout", "-b", "feature")
+        monkeypatch.chdir(tmp_path)
+        # the merge-base's contract finding is grandfathered...
+        assert cli_main(["--changed", "."]) == 0
+        assert "baselined" in capsys.readouterr().out
+        # ...but NEW contract drift on the branch fails
+        _BREAK_BY_RULE["LO302"](tmp_path)
+        assert cli_main(["--changed", "."]) == 1
+        out = capsys.readouterr().out
+        assert "LO302" in out
+
+
+class TestContractRegistryAntiRot:
+    """The registry must keep extracting ALL of the real tree's
+    artifacts — a refactor that silently breaks one extraction would
+    make the parity rules vacuously pass."""
+
+    def test_every_registry_section_nonempty_on_real_tree(self):
+        from learningorchestra_tpu.analysis.registry import build_registry
+
+        registry = build_registry(_REPO_ROOT)
+        assert registry.problems == []
+        assert registry.run_sh == "deploy/run.sh"
+        for section in (
+            "env_reads",
+            "validated_explicit",
+            "validated_resolved",
+            "manifest_knobs",
+            "metrics",
+            "doc_metrics",
+            "doc_knobs",
+            "doc_faults",
+            "fault_points",
+        ):
+            assert getattr(registry, section), f"{section} extracted empty"
+        # the scale the rules police — not one token fixture each
+        assert len(registry.env_reads) >= 40
+        assert len(registry.validated) >= 40
+        assert len(registry.metrics) >= 50
+        assert len(registry.doc_knobs) >= 40
+        assert len(registry.fault_points) >= 8
+
+    def test_static_metrics_match_docs_both_ways(self):
+        from learningorchestra_tpu.analysis.registry import build_registry
+
+        registry = build_registry(_REPO_ROOT)
+        assert set(registry.metrics) == set(registry.doc_metrics)
+
+    def test_declared_families_snapshot(self):
+        """The MetricsRegistry introspection hook LO303's anti-rot
+        story leans on: name -> kind for every declared family."""
+        from learningorchestra_tpu.telemetry.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        registry.counter("lo_x_total", "x events")
+        registry.gauge("lo_y_rows", "y rows")
+        registry.histogram("lo_z_seconds", "z latency")
+        assert registry.declared_families() == {
+            "lo_x_total": "counter",
+            "lo_y_rows": "gauge",
+            "lo_z_seconds": "histogram",
+        }
+
+    def test_live_declarations_visible_to_static_extraction(self):
+        """Families declared through the live registry by an imported
+        module must be names the static extraction also found — the
+        two views of 'declared' cannot drift."""
+        from learningorchestra_tpu.analysis.registry import build_registry
+        from learningorchestra_tpu.telemetry import metrics as _metrics
+        from learningorchestra_tpu.testing import faults  # noqa: F401 declares lo_fault_*
+
+        registry = build_registry(_REPO_ROOT)
+        live = _metrics.global_registry().declared_families()
+        lo_families = {
+            name for name in live if name.startswith("lo_")
+        }
+        missing = lo_families - set(registry.metrics)
+        assert not missing, (
+            f"live-declared families invisible to the registry: {missing}"
+        )
+
+
+def _copy_real_tree(tmp_path):
+    target = tmp_path / "tree"
+    target.mkdir()
+    for part in ("learningorchestra_tpu", "deploy", "docs"):
+        shutil.copytree(
+            os.path.join(_REPO_ROOT, part),
+            target / part,
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+    return target
+
+
+class TestContractMutationsOnRealTree:
+    """ISSUE 16 acceptance: seeded mutations of the REAL artifacts
+    each produce exactly the expected new finding — proof the rules
+    police the real deployment surface, not just synthetic fixtures."""
+
+    def test_real_tree_is_clean(self, tmp_path):
+        tree = _copy_real_tree(tmp_path)
+        assert _project_findings(tree) == []
+
+    def test_deleting_a_run_sh_validation_fires_lo301(self, tmp_path):
+        tree = _copy_real_tree(tmp_path)
+        run_sh = tree / "deploy" / "run.sh"
+        text = run_sh.read_text()
+        assert '"LO_STORE_COMPRESS",' in text
+        run_sh.write_text(text.replace('"LO_STORE_COMPRESS",', "", 1))
+        findings = _project_findings(tree)
+        assert [f.rule for f in findings] == ["LO301"]
+        assert "LO_STORE_COMPRESS" in findings[0].message
+
+    def test_deleting_a_metric_row_fires_lo303(self, tmp_path):
+        tree = _copy_real_tree(tmp_path)
+        doc = tree / "docs" / "observability.md"
+        lines = doc.read_text().splitlines(keepends=True)
+        victim = victim_name = None
+        for index, line in enumerate(lines):
+            match = re.match(r"\|\s*`(lo_[a-z0-9_]+)`\s*\|", line)
+            if match and "` / `" not in line:
+                victim, victim_name = index, match.group(1)
+                break
+        assert victim is not None, "no single-family metric row found"
+        del lines[victim]
+        doc.write_text("".join(lines))
+        findings = _project_findings(tree)
+        assert [f.rule for f in findings] == ["LO303"]
+        assert victim_name in findings[0].message
+
+    def test_deleting_a_docs_knob_row_fires_lo306(self, tmp_path):
+        tree = _copy_real_tree(tmp_path)
+        doc = tree / "docs" / "dataplane.md"
+        lines = doc.read_text().splitlines(keepends=True)
+        keep = [
+            line
+            for line in lines
+            if not line.startswith("| `LO_WIRE_ROWS` ")
+        ]
+        assert len(keep) == len(lines) - 1
+        doc.write_text("".join(keep))
+        findings = _project_findings(tree)
+        assert [f.rule for f in findings] == ["LO306"]
+        assert "LO_WIRE_ROWS" in findings[0].message
+
+    def test_shipped_tree_carries_no_baseline_file(self):
+        """The sweep ended EMPTY: every LO3xx finding was fixed or
+        carries a justified in-place allow — no grandfathered
+        backlog."""
+        assert not os.path.exists(
+            os.path.join(_REPO_ROOT, "analysis-baseline.txt")
         )
 
 
