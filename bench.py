@@ -1056,6 +1056,123 @@ def bench_coalesce() -> dict:
     return out
 
 
+def bench_obs(X, y) -> dict:
+    """The fleet observability plane's own cost (docs/observability.md):
+    the in-store TSDB's scrape+store+rollup wall at 8 members x 200
+    families, the stitcher's merge latency for a 5-process trace, and
+    the kernel suite's recording overhead re-measured with a LIVE
+    collector — the <2% attribution contract now covers retention too,
+    so a collector that starts taxing the device path is a flagged
+    regression, not a silent one."""
+    from learningorchestra_tpu.core.store import InMemoryStore
+    from learningorchestra_tpu.telemetry import metrics as _metrics
+    from learningorchestra_tpu.telemetry import stitch as _stitch
+    from learningorchestra_tpu.telemetry import tracing as _tracing
+    from learningorchestra_tpu.telemetry import tsdb as _tsdb
+
+    members, families, ticks = 8, 200, 5
+
+    def body(member: int, tick: int) -> str:
+        # values move every tick so delta compression does real work;
+        # one histogram family exercises the bucket-merge + p99 path
+        lines = [
+            f"lo_bench_family_{f}_total {tick * 10 + member + f}"
+            for f in range(families - 1)
+        ]
+        for le, cum in (("0.1", 5 * tick), ("1.0", 9 * tick), ("+Inf", 10 * tick)):
+            lines.append(
+                f'lo_serve_request_seconds_bucket{{le="{le}"}} {cum}'
+            )
+        lines.append(f"lo_serve_request_seconds_sum {tick * 1.5}")
+        lines.append(f"lo_serve_request_seconds_count {10 * tick}")
+        return "\n".join(lines) + "\n"
+
+    store = InMemoryStore()
+    ring = _tsdb.TSDB(store)
+    base_ts = 1_000_000.0
+    start = time.perf_counter()
+    for tick in range(ticks):
+        for member in range(members):
+            vals = _tsdb.parse_samples(body(member, tick + 1))
+            ring.append(
+                f"m{member}", "bench", vals, ts=base_ts + 60.0 * tick
+            )
+    ingest_s = time.perf_counter() - start
+    start = time.perf_counter()
+    rollups = _tsdb.window_rollups(
+        store,
+        "lo_serve_request_seconds",
+        600.0,
+        now=base_ts + 60.0 * ticks,
+    )
+    rollup_s = time.perf_counter() - start
+
+    # stitch latency: 5 process rows (distinct service labels group
+    # separately even in one process) under one correlation ID
+    cid = "bench_stitch_cid"
+    for index in range(5):
+        trace_obj = _tracing.Trace(cid)
+        with _tracing.activate(trace_obj):
+            for _ in range(40):
+                with _tracing.span("op"):
+                    pass
+        _tracing.export_trace(trace_obj, service=f"bench_proc{index}")
+    start = time.perf_counter()
+    stitched = _stitch.stitched_trace(cid)
+    stitch_ms = (time.perf_counter() - start) * 1000.0
+
+    # recording overhead with the collector LIVE: same suite + span
+    # methodology as bench_kernels, plus a collector appending this
+    # process's registry into a store during the run. 0.5 s interval:
+    # 120x the production default (60 s), so the measured tax is a
+    # conservative ceiling on what a deployment pays, without timing
+    # the degenerate collect-continuously regime
+    kernels, suite, _, _, _ = _make_kernel_suite(X, y, subset_k=4)
+    suite()
+    plain_s = _best_of(suite, repeats=2)
+
+    def suite_recording():
+        trace_obj = _tracing.Trace(name="bench_obs")
+        with _tracing.activate(trace_obj):
+            for name, kernel in kernels.items():
+                with _tracing.span(f"kernel:{name}"):
+                    kernel()
+
+    collector = _tsdb.Collector(
+        InMemoryStore(),
+        _metrics.global_registry(),
+        instance="bench",
+        service="bench",
+        interval_s=0.5,
+    )
+    collector.start()
+    try:
+        live_s = _best_of(suite_recording, repeats=2)
+    finally:
+        collector.stop()
+
+    return {
+        "members": members,
+        "families": families,
+        "ticks": ticks,
+        "ingest_store_s": round(ingest_s, 4),
+        "ingest_per_tick_ms": round(ingest_s / ticks * 1000.0, 2),
+        "rollup_s": round(rollup_s, 4),
+        # deterministic synthetic data -> a constant; its presence
+        # proves the windowed-percentile path ran
+        "rollup_p99": (rollups.get("m0") or {}).get("p99"),
+        "stitch_processes": len(stitched["otherData"]["processes"]),
+        "stitch_ms": round(stitch_ms, 2),
+        "suite_s": round(plain_s, 4),
+        "suite_collector_on_s": round(live_s, 4),
+        "collector_overhead_pct": round(
+            100.0 * (live_s / plain_s - 1.0), 2
+        ),
+        "collector_ticks": collector.ticks,
+        "collector_errors": collector.errors,
+    }
+
+
 def bench_embeddings() -> dict:
     """Section 3: the PCA + t-SNE north-star wall-clocks."""
     from learningorchestra_tpu.ops.pca import pca_embedding
@@ -1311,6 +1428,11 @@ _HIGHER_IS_BETTER = (
 # crept back toward a thread stack (docs/web.md)
 _LOWER_PRIORITY = (
     "wire_read_bytes", "wire_write_bytes", "h2d_bytes", "rss_per_waiter",
+    # the live-collector attribution tax (bench_obs): unlike the
+    # generic overhead_pct fact below, this one gates DOWN — retention
+    # creeping into the device path is exactly the regression the
+    # <2% contract exists to catch (docs/observability.md)
+    "collector_overhead",
 )
 _LOWER_IS_BETTER = ("_s", "_ms", "seconds", "p50_ms", "p99_ms")
 # numeric facts that are not performance (never gated, still diffed)
@@ -1528,6 +1650,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
     section("serve", bench_serve)  # the online predict lane's latency
     section("waiters", bench_waiters)  # push job completion (docs/web.md)
     section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch
+    section("obs", lambda: bench_obs(X, y))  # fleet plane's own cost
     section("embeddings", bench_embeddings)
     section("kernels_wide", bench_kernels_wide)
 

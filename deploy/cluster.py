@@ -105,6 +105,7 @@ relaunch, so a relaunch never collides with a surviving old group.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import shlex
@@ -293,6 +294,43 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("resume.enabled must be 0 or 1")
         elif value < 1:  # every_segments
             raise SystemExit("resume.every_segments must be >= 1")
+    tsdb = manifest.setdefault("tsdb", {})
+    for key in tsdb:
+        if key not in _TSDB_KNOBS:
+            raise SystemExit(
+                f"unknown tsdb knob {key!r} (have: "
+                f"{', '.join(sorted(_TSDB_KNOBS))})"
+            )
+        value = tsdb[key]
+        if key == "interval_s":
+            if (
+                isinstance(value, bool)
+                or not isinstance(value, (int, float))
+                or value <= 0
+            ):
+                raise SystemExit("tsdb.interval_s must be > 0")
+        # bool-is-int trap, same as the sched knobs: `"points": true`
+        # would stringify to "True" and fail every preflight downstream
+        elif isinstance(value, bool) or not isinstance(value, int) or value < 1:
+            raise SystemExit(f"tsdb.{key} must be an integer >= 1")
+    slo = manifest.setdefault("slo", {})
+    for key in slo:
+        if key not in _SLO_KNOBS:
+            raise SystemExit(
+                f"unknown slo knob {key!r} (have: "
+                f"{', '.join(sorted(_SLO_KNOBS))})"
+            )
+        value = slo[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SystemExit(f"slo.{key} must be a number")
+        if key in ("queue_depth", "replication_lag"):
+            if not isinstance(value, int) or value < 1:
+                raise SystemExit(f"slo.{key} must be an integer >= 1")
+        elif key == "window_s":
+            if value <= 0:
+                raise SystemExit("slo.window_s must be > 0")
+        elif value < 0:  # serve_p99_s / http_5xx_rate: 0 = alert always
+            raise SystemExit(f"slo.{key} must be >= 0")
     replication = manifest.setdefault("replication", {})
     for key in replication:
         if key not in _REPLICATION_KNOBS:
@@ -412,6 +450,29 @@ _RESUME_KNOBS = {
     "every_segments": "LO_RESUME_EVERY_SEGMENTS",
 }
 
+# manifest tsdb.<knob> -> the env var every machine receives
+# (docs/observability.md). Cluster-wide: the retention cap and scrape
+# cadence shape ONE shared ring in the head store, and trace_ring
+# bounds every member's span export buffer the stitcher drains —
+# a member with a smaller ring would silently drop the oldest spans
+# out of stitched traces.
+_TSDB_KNOBS = {
+    "points": "LO_TSDB_POINTS",
+    "interval_s": "LO_METRICS_INTERVAL_S",
+    "trace_ring": "LO_TRACE_RING",
+}
+
+# manifest slo.<knob> -> the env var every machine receives
+# (docs/observability.md). Cluster-wide: burn verdicts must come from
+# ONE threshold set no matter which member's /debug/slo is asked.
+_SLO_KNOBS = {
+    "window_s": "LO_SLO_WINDOW_S",
+    "serve_p99_s": "LO_SLO_SERVE_P99_S",
+    "http_5xx_rate": "LO_SLO_5XX_RATE",
+    "queue_depth": "LO_SLO_QUEUE_DEPTH",
+    "replication_lag": "LO_SLO_REPL_LAG",
+}
+
 # manifest replication.<knob> (docs/replication.md); the head machine
 # runs the whole store plane, every machine's LO_STORE_URL names the
 # primary AND the follower for client-side failover
@@ -482,6 +543,23 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _RESUME_KNOBS.items():
         if knob in manifest.get("resume", {}):
             shared[env_var] = str(manifest["resume"][knob])
+    for knob, env_var in _TSDB_KNOBS.items():
+        if knob in manifest.get("tsdb", {}):
+            shared[env_var] = str(manifest["tsdb"][knob])
+    for knob, env_var in _SLO_KNOBS.items():
+        if knob in manifest.get("slo", {}):
+            shared[env_var] = str(manifest["slo"][knob])
+    # the driver scrapes every member centrally (up()'s scrape loop)
+    # and pushes into the head store's TSDB ring, so the per-process
+    # fallback collectors stay off; an explicit manifest env wins
+    shared.setdefault("LO_TSDB_COLLECT", "0")
+    # the fan-out list GET /traces/<cid> stitches across: the head
+    # store plus the head's seven services (worker machines have no
+    # REST surface to drain)
+    plane = [f"http://{head['host']}:{manifest['store_port']}"] + [
+        f"http://{head['host']}:{port}" for port in SERVICE_PORTS
+    ]
+    shared.setdefault("LO_PLANE_MEMBERS", ",".join(plane))
     if "models_dir" in manifest:
         shared["LO_MODELS_DIR"] = manifest["models_dir"]
 
@@ -656,8 +734,18 @@ class Machine:
 
 # services on their reference ports (learningorchestra_tpu/services);
 # the driver stays import-free of the package so it runs on machines
-# with only the deploy/ tree checked out
-SERVICE_PORTS = (5000, 5001, 5002, 5003, 5004, 5005, 5006)
+# with only the deploy/ tree checked out. The names label the TSDB
+# samples the driver pushes into the store (POST /metrics/ingest).
+SERVICE_NAMES = {
+    5000: "database_api",
+    5001: "projection",
+    5002: "model_builder",
+    5003: "data_type_handler",
+    5004: "histogram",
+    5005: "tsne",
+    5006: "pca",
+}
+SERVICE_PORTS = tuple(sorted(SERVICE_NAMES))
 
 # the families the cluster summary line aggregates across members
 SUMMARY_FAMILIES = (
@@ -675,9 +763,14 @@ SUMMARY_FAMILIES = (
 )
 
 
-def parse_prometheus(text: str) -> dict:
+def parse_prometheus(text: str, strict: bool = False) -> dict:
     """Family → summed sample value (labels collapsed; histogram bucket
-    samples skipped — the driver's summary wants totals, not shape)."""
+    samples skipped — the driver's summary wants totals, not shape).
+
+    ``strict=True`` raises ValueError on a non-comment line that is not
+    a parseable sample: the per-member scrape uses it so a truncated or
+    corrupted body surfaces as a counted skip, never as silently-wrong
+    totals folded into the cluster summary."""
     out: dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -686,7 +779,11 @@ def parse_prometheus(text: str) -> dict:
         try:
             name_part, value_part = line.rsplit(" ", 1)
             value = float(value_part)
-        except ValueError:
+        except ValueError as error:
+            if strict:
+                raise ValueError(
+                    f"unparseable sample line {line!r}"
+                ) from error
             continue
         family = name_part.split("{", 1)[0]
         if family.endswith("_bucket"):
@@ -695,27 +792,79 @@ def parse_prometheus(text: str) -> dict:
     return out
 
 
-def scrape_member_metrics(urls: list[str]) -> dict:
+def scrape_member_metrics(urls: list[str]) -> tuple[dict, dict]:
     """Scrape each member's ``/metrics``; unreachable members (worker
     machines have no REST surface, loopback-bound services aren't
-    visible from the driver) are skipped, not errors."""
+    visible from the driver) are skipped, not errors. A member that
+    answers with a malformed or truncated body (mid-restart, a proxy
+    error page, a cut connection) is ALSO a per-member skip — counted
+    in ``_malformed`` for the summary line, never a scrape-thread
+    crash. Returns ``(totals, texts)``: the summed families plus each
+    healthy member's raw exposition text keyed by URL, for the central
+    TSDB ingest push."""
     totals: dict[str, float] = {}
+    texts: dict[str, str] = {}
     reachable = 0
+    malformed = 0
     for url in urls:
         try:
             with urllib.request.urlopen(url + "/metrics", timeout=3) as resp:
-                families = parse_prometheus(resp.read().decode())
-        except (OSError, ValueError):
+                raw = resp.read()
+        except (OSError, http.client.HTTPException):
+            # http.client.HTTPException covers IncompleteRead: a member
+            # dying mid-response truncates the body during read()
+            continue
+        try:
+            text = raw.decode()
+            families = parse_prometheus(text, strict=True)
+        except (UnicodeDecodeError, ValueError):
+            malformed += 1
             continue
         reachable += 1
+        texts[url] = text
         for family, value in families.items():
             totals[family] = totals.get(family, 0.0) + value
     totals["_members"] = reachable
-    return totals
+    totals["_malformed"] = malformed
+    return totals, texts
+
+
+def push_member_metrics(store_url: str, texts: dict, log=print) -> int:
+    """Push each scraped member's raw exposition text into the head
+    store's TSDB ring (``POST /metrics/ingest``) — the cluster-mode
+    replacement for every runner's in-process fallback collector
+    (which the driver disables via LO_TSDB_COLLECT=0). The store side
+    parses and delta-compresses; the driver stays import-free."""
+    pushed = 0
+    for url, text in texts.items():
+        instance = url.split("//", 1)[-1]
+        port = instance.rsplit(":", 1)[-1]
+        service = SERVICE_NAMES.get(
+            int(port) if port.isdigit() else -1, "store"
+        )
+        body = json.dumps(
+            {"instance": instance, "service": service, "text": text}
+        ).encode()
+        request = urllib.request.Request(
+            store_url + "/metrics/ingest",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=3) as resp:
+                if resp.status == 200:
+                    pushed += 1
+        except (OSError, http.client.HTTPException) as error:
+            log(f"[cluster] metrics ingest push failed for {instance}: "
+                f"{error}")
+    return pushed
 
 
 def metrics_summary_line(totals: dict) -> str:
     parts = [f"members={int(totals.get('_members', 0))}"]
+    if totals.get("_malformed"):
+        parts.append(f"malformed={int(totals['_malformed'])}")
     for family in SUMMARY_FAMILIES:
         if family in totals:
             value = totals[family]
@@ -803,9 +952,14 @@ def up(manifest: dict, log=print) -> int:
 
     def scrape_loop() -> None:
         while not stopping.wait(scrape_interval):
-            totals = scrape_member_metrics(scrape_urls)
-            if totals.get("_members"):
+            totals, texts = scrape_member_metrics(scrape_urls)
+            if totals.get("_members") or totals.get("_malformed"):
                 log(metrics_summary_line(totals))
+            if texts:
+                # retention lives IN the store: each healthy member's
+                # raw text lands in the head store's __lo_metrics__
+                # ring, where /metrics/history and the SLO engine read
+                push_member_metrics(store_url, texts, log)
 
     if scrape_interval > 0:
         threading.Thread(

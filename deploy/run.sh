@@ -99,6 +99,25 @@
 #                         integral >= 1 — larger N trades re-done work
 #                         after a crash for fewer artifact writes)
 #
+# Fleet observability knobs (docs/observability.md has the full table):
+#   LO_TSDB_POINTS        retained samples per metric family x instance
+#                         in the store's __lo_metrics__ ring (default
+#                         512; strictly integral >= 1)
+#   LO_TSDB_COLLECT       0 = no in-process fallback collector (the
+#                         cluster driver sets this and scrapes all
+#                         members centrally); default 1
+#   LO_METRICS_INTERVAL_S scrape cadence in seconds (shared with the
+#                         cluster driver's summary loop; default 60)
+#   LO_TRACE_RING         per-process trace/span-export ring size
+#                         (default 256; strictly integral >= 1)
+#   LO_PLANE_MEMBERS      comma list of member base URLs GET /traces/
+#                         <cid> stitches across (unset = local only)
+#   LO_SLO_WINDOW_S       SLO evaluation window     (default 600, > 0)
+#   LO_SLO_SERVE_P99_S    serve p99 latency ceiling  (default 1.0)
+#   LO_SLO_5XX_RATE       5xx responses/s ceiling    (default 0.5)
+#   LO_SLO_QUEUE_DEPTH    sched queue-depth ceiling  (default 64)
+#   LO_SLO_REPL_LAG       replication-lag ceiling    (default 1000)
+#
 # Fault injection (chaos drills ONLY — docs/replication.md):
 #   LO_FAULT_*            named fault points (kill/delay/error/torn);
 #                         validated below so a typo'd point or spec
@@ -148,7 +167,8 @@ from learningorchestra_tpu.utils import webloop
 webloop.validate_env()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
              "LO_STORE_SYNC_REPL", "LO_WIRE_V2", "LO_SHAPE_BUCKETS",
-             "LO_EPHEMERAL", "LO_REPLICATE", "LO_STACK_EXIT_ON_STDIN_EOF"):
+             "LO_EPHEMERAL", "LO_REPLICATE", "LO_STACK_EXIT_ON_STDIN_EOF",
+             "LO_TSDB_COLLECT"):
     value = os.environ.get(knob, "").strip()
     if value and value not in ("0", "1"):
         raise SystemExit(f"{knob} must be 0 or 1, got {value!r}")
@@ -200,7 +220,8 @@ for knob, floor in (("LO_WIRE_ROWS", 1), ("LO_WIRE_ROWS_BIN", 1),
                     ("LO_COMPACT_RECORDS", 1), ("LO_BUILD_WORKERS", 1),
                     ("LO_CHUNK_RETRIES", 0), ("LO_READ_RETRIES", 0),
                     ("LO_WORKERS", 0), ("LO_TOTAL_PROCESSES", 0),
-                    ("LO_PROCESS_BASE", 0), ("LO_MAX_RESTARTS", 0)):
+                    ("LO_PROCESS_BASE", 0), ("LO_MAX_RESTARTS", 0),
+                    ("LO_TRACE_RING", 1), ("LO_TSDB_POINTS", 1)):
     value = os.environ.get(knob, "").strip()
     if value:
         try:
@@ -234,6 +255,11 @@ if value:
 # strict integer >= 1 — "0.5" silently becoming "never checkpoint"
 # would void the whole crash-resume contract at the worst moment
 config.resume_enabled(); config.resume_every_segments()
+# SLO thresholds (docs/observability.md): a typo'd LO_SLO_* must
+# refuse bring-up — silently alerting at the default threshold is as
+# bad as silently never alerting
+from learningorchestra_tpu.telemetry import slo as lo_slo
+lo_slo.validate_env()
 # chaos fault points: a typo'd LO_FAULT_* must fail bring-up loudly
 from learningorchestra_tpu.testing import faults
 try:

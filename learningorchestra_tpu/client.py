@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import time
 import urllib.parse
+import uuid
 
 import requests
 
@@ -30,11 +31,27 @@ from learningorchestra_tpu.sched import policy as _policy
 
 cluster_url = None
 
+# One correlation ID per pipeline run (per Context), sent as
+# X-Correlation-Id on EVERY request so the whole client-driven pipeline
+# — ingest, projection, build, predict — lands under one ID the server
+# threads through jobs and SPMD spans, and GET /traces/<cid> stitches
+# into one cross-process Chrome trace (docs/observability.md). Same
+# format the server mints for headerless callers
+# (telemetry/tracing.mint_correlation_id).
+CORRELATION_HEADER = "X-Correlation-Id"
+correlation_id = None
+
+
+def _correlation_headers() -> dict:
+    return {CORRELATION_HEADER: correlation_id} if correlation_id else {}
+
 
 class Context:
     def __init__(self, ip_from_cluster: str):
-        global cluster_url
+        global cluster_url, correlation_id
         cluster_url = "http://" + ip_from_cluster
+        correlation_id = uuid.uuid4().hex[:16]
+        self.correlation_id = correlation_id
 
 
 def _banner(body: str) -> None:
@@ -102,7 +119,11 @@ class AsyncronousWait:
         if cached is not None:
             return cached
         try:
-            response = requests.get(base + "/health", timeout=2)
+            response = requests.get(
+                base + "/health",
+                headers=_correlation_headers(),
+                timeout=2,
+            )
             supported = bool(
                 response.status_code == 200
                 and response.json().get("job_wait")
@@ -129,7 +150,10 @@ class AsyncronousWait:
         while True:
             try:
                 response = requests.get(
-                    url, params={"timeout": "25"}, timeout=40
+                    url,
+                    params={"timeout": "25"},
+                    headers=_correlation_headers(),
+                    timeout=40,
                 )
             except requests.RequestException:
                 attempt += 1
@@ -183,6 +207,7 @@ class AsyncronousWait:
             response = requests.get(
                 url=reader._url(filename),
                 params={"skip": "0", "limit": "1", "query": "{}"},
+                headers=_correlation_headers(),
                 timeout=40,
             )
             if response.status_code in (429, 503):
@@ -231,7 +256,10 @@ class _RestClient:
     def _get(self, suffix: str = "", params=None, pretty_response: bool = True):
         return self._treat(
             requests.get(
-                url=self._url(suffix), params=params, timeout=self._TIMEOUT_S
+                url=self._url(suffix),
+                params=params,
+                headers=_correlation_headers(),
+                timeout=self._TIMEOUT_S,
             ),
             pretty_response,
         )
@@ -239,7 +267,10 @@ class _RestClient:
     def _post(self, suffix: str = "", body=None, pretty_response: bool = True):
         return self._treat(
             requests.post(
-                url=self._url(suffix), json=body, timeout=self._TIMEOUT_S
+                url=self._url(suffix),
+                json=body,
+                headers=_correlation_headers(),
+                timeout=self._TIMEOUT_S,
             ),
             pretty_response,
         )
@@ -247,14 +278,21 @@ class _RestClient:
     def _patch(self, suffix: str = "", body=None, pretty_response: bool = True):
         return self._treat(
             requests.patch(
-                url=self._url(suffix), json=body, timeout=self._TIMEOUT_S
+                url=self._url(suffix),
+                json=body,
+                headers=_correlation_headers(),
+                timeout=self._TIMEOUT_S,
             ),
             pretty_response,
         )
 
     def _delete(self, suffix: str = "", pretty_response: bool = True):
         return self._treat(
-            requests.delete(url=self._url(suffix), timeout=self._TIMEOUT_S),
+            requests.delete(
+                url=self._url(suffix),
+                headers=_correlation_headers(),
+                timeout=self._TIMEOUT_S,
+            ),
             pretty_response,
         )
 

@@ -619,6 +619,11 @@ class JobManager:
                 except Exception:  # noqa: BLE001
                     traceback.print_exc()
             self._journal_event(record, state, error=error)
+            if record.trace is not None:
+                # cross-process stitching: the job's span tree (cid
+                # inherited from the submitting request) joins the
+                # export buffer at terminal state, where it is complete
+                _tracing.export_trace(record.trace, service="jobs")
             with self._lock:
                 # identity check: after record.state went terminal a
                 # same-name successor may have registered its own task,

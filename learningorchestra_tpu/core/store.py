@@ -222,6 +222,16 @@ class DocumentStore:
     def drop(self, collection: str) -> None:
         raise NotImplementedError
 
+    def trim_collection(self, collection: str, max_docs: int) -> int:
+        """Ring-collection cap discipline: drop the OLDEST overlay
+        documents (ascending int ``_id``, metadata excluded) until at
+        most ``max_docs`` remain; returns how many were removed. The
+        bounded-retention primitive behind ``__lo_metrics__``
+        (telemetry/tsdb.py) — rev-bumping like every other mutation, so
+        paged readers and caches see the eviction. Columnar block rows
+        are out of scope: rings are row-document collections."""
+        raise NotImplementedError
+
     # --- writes ---------------------------------------------------------------
     def insert_one(self, collection: str, document: dict) -> None:
         raise NotImplementedError
@@ -761,6 +771,8 @@ class InMemoryStore(DocumentStore):
                 Column.from_values(record["d"]),
                 record["s"],
             )
+        elif op == "trim":
+            self._apply_trim_locked(record["c"], record["n"])
         elif op == "create":
             self._collections.setdefault(record["c"], _Collection())
         elif op == "drop":
@@ -1301,6 +1313,39 @@ class InMemoryStore(DocumentStore):
             self._collections.pop(collection, None)
             self._log_locked({"op": "drop", "c": collection})
             self._drop_spill_folder_locked(collection)
+
+    def _apply_trim_locked(self, collection: str, max_docs: int) -> int:
+        col = self._collections.get(collection)
+        if col is None:
+            return 0
+        data_ids = sorted(
+            doc_id
+            for doc_id in col.rows
+            if doc_id != METADATA_ID and _is_int_id(doc_id)
+        )
+        excess = len(data_ids) - max_docs
+        if excess <= 0:
+            return 0
+        for doc_id in data_ids[:excess]:
+            del col.rows[doc_id]
+        col.rev = next(self._rev_seq)
+        return excess
+
+    def trim_collection(self, collection: str, max_docs: int) -> int:
+        if isinstance(max_docs, bool) or not isinstance(max_docs, int):
+            raise ValueError(f"max_docs must be an integer, got {max_docs!r}")
+        if max_docs < 0:
+            raise ValueError(f"max_docs must be >= 0, got {max_docs}")
+        with self._lock:
+            removed = self._apply_trim_locked(collection, max_docs)
+            if removed:
+                # The WAL logs the CAP, not the removed ids: replay and
+                # follower replication re-derive the same eviction from
+                # the same state (oldest-first is deterministic).
+                self._log_locked(
+                    {"op": "trim", "c": collection, "n": max_docs}
+                )
+            return removed
 
     def insert_one(self, collection: str, document: dict) -> None:
         with self._lock:

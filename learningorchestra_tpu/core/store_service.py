@@ -344,6 +344,15 @@ def create_store_app(
             # loss-window semantics); also exported as
             # lo_store_loss_window on /metrics
             payload["loss_window"] = role["loss_window"]
+        # SLO verdict over the in-store TSDB (telemetry/slo.py):
+        # best-effort — health must answer even when the ring is empty
+        # or the evaluation trips on a half-written tick
+        try:
+            from learningorchestra_tpu.telemetry import slo as _slo
+
+            payload["degraded"] = bool(_slo.status(store)["degraded"])
+        except Exception:  # noqa: BLE001
+            payload["degraded"] = False
         return payload, 200
 
     @app.route("/vote", methods=("POST",))
@@ -652,6 +661,20 @@ def create_store_app(
     @app.route("/c/<name>/count", methods=("GET",))
     def count(request, name):
         return {"count": store.count(name)}, 200
+
+    @app.route("/c/<name>/trim", methods=("POST",))
+    @guarded
+    @mutating
+    def trim_collection(request, name):
+        removed = store.trim_collection(
+            name, request.get_json()["max_docs"]
+        )
+        return {"removed": removed}, 200
+
+    # fleet observability plane: /metrics/history, /metrics/ingest,
+    # /debug/slo — the store head is where the cluster driver's
+    # collector posts scraped samples (deploy/cluster.py)
+    app.register_observability(store)
 
     return app
 
@@ -1404,6 +1427,12 @@ class RemoteStore(DocumentStore):
             f"/c/{collection}/update_one",
             {"query": query, "new_values": new_values},
         )
+
+    def trim_collection(self, collection: str, max_docs: int) -> int:
+        payload = self._post(
+            f"/c/{collection}/trim", {"max_docs": max_docs}
+        )
+        return int(payload.get("removed", 0))
 
     def set_field_values(
         self, collection: str, field: str, values_by_id: dict

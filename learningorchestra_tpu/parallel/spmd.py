@@ -334,6 +334,9 @@ class SpmdDispatcher:
             finally:
                 if job["op"] != _PING_OP:
                     _tracing.remember_trace(trace)
+                    # worker spans join the cid-keyed export buffer so
+                    # a stitched trace shows the SPMD side too
+                    _tracing.export_trace(trace, service="spmd")
 
     def shutdown_workers(self) -> None:
         self._stop_heartbeat.set()

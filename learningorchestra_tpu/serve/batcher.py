@@ -279,6 +279,9 @@ class MicroBatcher:
         finally:
             if trace is not None:
                 _tracing.remember_trace(trace)
+                # sampled forwards also feed the fleet stitcher's
+                # export buffer (GET /debug/spans)
+                _tracing.export_trace(trace, service="serve")
 
     def _forward_traced(self, group: list, span) -> None:
         try:

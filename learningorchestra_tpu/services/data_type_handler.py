@@ -25,6 +25,7 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     jobs = jobs or JobManager()
     register_store(store)
     app.register_job_routes(jobs)
+    app.register_observability(store)
     # fieldtypes passes are legitimately repeatable on one dataset (the
     # reference allows back-to-back casts), so job names take a sequence
     # suffix instead of colliding as duplicates

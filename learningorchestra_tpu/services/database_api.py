@@ -44,6 +44,7 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     # error — inspectable and cancellable over REST instead of only via
     # each collection's metadata row.
     app.register_job_routes(jobs)
+    app.register_observability(store)
 
     @app.route("/files", methods=("POST",))
     def create_file(request):

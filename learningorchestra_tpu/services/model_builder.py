@@ -109,6 +109,7 @@ def create_app(
     # predict/write phases under the request's correlation ID — plus
     # cooperative cancellation of queued/running builds.
     app.register_job_routes(jobs)
+    app.register_observability(store)
 
     def checkpoint_path(name: str) -> str:
         return _checkpoint_path(models_dir, name)

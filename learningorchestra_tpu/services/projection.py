@@ -24,6 +24,7 @@ def create_app(store: DocumentStore, jobs: JobManager | None = None) -> WebApp:
     jobs = jobs or JobManager()
     register_store(store)
     app.register_job_routes(jobs)
+    app.register_observability(store)
 
     @app.route("/projections/<parent_filename>", methods=("POST",))
     def create_projection(request, parent_filename):

@@ -94,6 +94,7 @@ metric catalog and scrape examples.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from learningorchestra_tpu.core.jobs import JobManager
@@ -315,6 +316,38 @@ def build_apps(
     }
 
 
+# One fallback collector per (process, store): main() starts it before
+# start_all, and start_all starts it for embedded callers (tests, the
+# verify drive) — whoever gets there first wins, the other is a no-op.
+_COLLECTORS: dict[int, object] = {}
+_COLLECTORS_LOCK = threading.Lock()
+
+
+def maybe_start_collector(
+    store: DocumentStore, instance: str = "runner", service: str = "runner"
+):
+    """Start the single-process fallback TSDB collector for ``store``
+    unless one is already running, collection is disabled
+    (``LO_TSDB_COLLECT=0`` — the cluster driver owns the scrape), or the
+    interval is zero. Returns the Collector, or None when gated off."""
+    from learningorchestra_tpu.telemetry import metrics as _metrics
+    from learningorchestra_tpu.telemetry import tsdb as _tsdb
+
+    if not (_tsdb.collect_enabled() and _tsdb.metrics_interval_s() > 0):
+        return None
+    with _COLLECTORS_LOCK:
+        collector = _COLLECTORS.get(id(store))
+        if collector is None:
+            collector = _tsdb.Collector(
+                store,
+                _metrics.global_registry(),
+                instance=instance,
+                service=service,
+            ).start()
+            _COLLECTORS[id(store)] = collector
+    return collector
+
+
 def start_all(
     store: Optional[DocumentStore] = None,
     images_dir: Optional[str] = None,
@@ -333,6 +366,7 @@ def start_all(
     """
     store = store if store is not None else InMemoryStore()
     images_dir = images_dir or os.path.join(os.getcwd(), "lo_images")
+    maybe_start_collector(store)
     servers = []
     apps = build_apps(store, images_dir, dispatcher, models_dir, jobs)
     for port, app in apps.items():
@@ -500,6 +534,29 @@ def main() -> None:
     # accepts traffic: never-started jobs re-enqueue, orphaned RUNNING
     # jobs go FAILED with finished:true so pollers terminate — the
     # crash the reference hangs on (docs/scheduler.md).
+    # ...and the fleet-observability knobs (docs/observability.md): a
+    # typo'd LO_SLO_* threshold must refuse bring-up, and an operator
+    # should see at boot whether this process self-scrapes into the
+    # store-backed TSDB ring or defers to a cluster driver
+    # (deploy/cluster.py sets LO_TSDB_COLLECT=0 and collects centrally
+    # through POST /metrics/ingest).
+    from learningorchestra_tpu.telemetry import slo as _slo
+    from learningorchestra_tpu.telemetry import tracing as _tracing
+    from learningorchestra_tpu.telemetry import tsdb as _tsdb
+
+    print(
+        "observability config: "
+        f"collect={_tsdb.collect_enabled()} "
+        f"interval_s={_tsdb.metrics_interval_s()} "
+        f"points={_tsdb.tsdb_points()} "
+        f"trace_ring={_tracing.trace_ring()} "
+        f"slo={_slo.validate_env()}",
+        flush=True,
+    )
+    maybe_start_collector(
+        store, instance=service or "runner", service=service or "runner"
+    )
+
     jobs = make_job_manager(store, scope=service or "all")
     recovered = recover_jobs(store, jobs)
     if recovered["requeued"] or recovered["orphaned"]:

@@ -226,27 +226,18 @@ def _iter_spans(trace_dict: dict):
         yield from _walk(root)
 
 
-def chrome_trace(trace) -> dict:
-    """A trace (``Trace`` or its ``as_dict()``) as Chrome trace-event
-    JSON — load it in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.
-
-    Spans become ``ph: "X"`` complete events laid out one row per OS
-    thread; ``ts`` is microseconds relative to the earliest span (the
-    absolute epoch anchor rides ``otherData``); byte-carrying spans
-    additionally feed cumulative ``ph: "C"`` counter tracks (one series
-    per flow: wire/h2d/d2h), so Perfetto draws bytes-moved-so-far under
-    the timeline."""
-    if hasattr(trace, "as_dict"):
-        trace = trace.as_dict()
+def span_events(span_roots, pid: int, t0: float) -> list[dict]:
+    """Span dict trees → Chrome ``ph: "X"`` complete events laid out
+    one row per OS thread, plus per-thread ``M`` name/sort metadata.
+    Shared by :func:`chrome_trace` (one process) and the fleet stitcher
+    (telemetry/stitch.py — one ``pid`` row per plane member, all
+    anchored to a common ``t0``)."""
     spans = [
         (span_dict, depth)
-        for span_dict, depth in _iter_spans(trace)
+        for root in span_roots
+        for span_dict, depth in _walk(root)
         if span_dict.get("start_ts") is not None
     ]
-    t0 = min(
-        (span_dict["start_ts"] for span_dict, _ in spans), default=0.0
-    )
-    pid = os.getpid()
     events: list[dict] = []
     tids = []
     for span_dict, _depth in spans:
@@ -290,6 +281,31 @@ def chrome_trace(trace) -> dict:
                 "args": {"sort_index": index},
             }
         )
+    return events
+
+
+def chrome_trace(trace) -> dict:
+    """A trace (``Trace`` or its ``as_dict()``) as Chrome trace-event
+    JSON — load it in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.
+
+    Spans become ``ph: "X"`` complete events laid out one row per OS
+    thread; ``ts`` is microseconds relative to the earliest span (the
+    absolute epoch anchor rides ``otherData``); byte-carrying spans
+    additionally feed cumulative ``ph: "C"`` counter tracks (one series
+    per flow: wire/h2d/d2h), so Perfetto draws bytes-moved-so-far under
+    the timeline."""
+    if hasattr(trace, "as_dict"):
+        trace = trace.as_dict()
+    spans = [
+        (span_dict, depth)
+        for span_dict, depth in _iter_spans(trace)
+        if span_dict.get("start_ts") is not None
+    ]
+    t0 = min(
+        (span_dict["start_ts"] for span_dict, _ in spans), default=0.0
+    )
+    pid = os.getpid()
+    events = span_events(trace.get("spans", ()), pid, t0)
     # cumulative byte counters along the timeline, stamped at each
     # contributing span's END (when the bytes have actually moved)
     totals = dict.fromkeys(_BYTE_ATTRS, 0)

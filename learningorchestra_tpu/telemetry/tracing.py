@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import os
 import threading
 import time
 import uuid
@@ -215,10 +216,20 @@ def record_span(name: str, duration_s: float, **meta) -> Optional[Span]:
 # the broadcast correlation ID) park in a bounded ring an operator can
 # dump (parallel/spmd.py logs the correlation id per job, and tests
 # assert attribution through here).
-_RECENT_LIMIT = 256
 _RECENT: "dict[str, Trace]" = {}
 _RECENT_ORDER: list[str] = []
 _RECENT_LOCK = threading.Lock()
+
+
+def trace_ring() -> int:
+    """Entries kept in the remembered-trace ring AND the per-cid span
+    export buffer (``LO_TRACE_RING``, strictly integral >= 1 — was a
+    hardcoded 256). Size it to the scrape interval: the stitcher
+    (telemetry/stitch.py) can only merge spans that have not been
+    evicted by newer requests before it fans out."""
+    from learningorchestra_tpu.sched.config import _int_env
+
+    return _int_env("LO_TRACE_RING", 256)
 
 
 def remember_trace(trace: Trace) -> None:
@@ -226,10 +237,88 @@ def remember_trace(trace: Trace) -> None:
         if trace.correlation_id not in _RECENT:
             _RECENT_ORDER.append(trace.correlation_id)
         _RECENT[trace.correlation_id] = trace
-        while len(_RECENT_ORDER) > _RECENT_LIMIT:
+        limit = trace_ring()
+        while len(_RECENT_ORDER) > limit:
             _RECENT.pop(_RECENT_ORDER.pop(0), None)
 
 
 def recall_trace(correlation_id: str) -> Optional[Trace]:
     with _RECENT_LOCK:
         return _RECENT.get(correlation_id)
+
+
+# --- cross-process span export ---------------------------------------------
+# The Dapper shape: every process keeps a bounded per-cid buffer of its
+# finished spans, drained over HTTP (``GET /debug/spans?cid=…`` —
+# utils/web.py registers it on every app) and merged fleet-wide by the
+# stitcher (telemetry/stitch.py). Groups are keyed "service@pid" so a
+# multi-service process contributes one row per service and a fan-out
+# that reaches the same process twice (a member list naming ourselves)
+# dedupes instead of duplicating.
+_EXPORT: dict[str, dict] = {}
+_EXPORT_ORDER: list[str] = []
+_EXPORT_LOCK = threading.Lock()
+
+
+def export_trace(trace: Trace, service: Optional[str] = None) -> None:
+    """Snapshot a trace's finished spans into the export buffer. Cheap
+    and safe to call per request (the REST middleware does) — empty
+    traces are skipped, and both the cid ring and each group's span
+    list are bounded by :func:`trace_ring`."""
+    snapshot = trace.as_dict()
+    spans = snapshot.get("spans") or []
+    if not spans:
+        return
+    label = service or "proc"
+    pid = os.getpid()
+    proc = f"{label}@{pid}"
+    with _EXPORT_LOCK:
+        entry = _EXPORT.get(trace.correlation_id)
+        if entry is None:
+            entry = {"ts": 0.0, "groups": {}}
+            _EXPORT[trace.correlation_id] = entry
+            _EXPORT_ORDER.append(trace.correlation_id)
+        group = entry["groups"].setdefault(
+            proc, {"service": label, "pid": pid, "spans": []}
+        )
+        group["spans"].extend(spans)
+        limit = trace_ring()
+        del group["spans"][:-limit]
+        entry["ts"] = time.time()
+        while len(_EXPORT_ORDER) > limit:
+            _EXPORT.pop(_EXPORT_ORDER.pop(0), None)
+
+
+def exported_spans(
+    correlation_id: Optional[str] = None, since: Optional[float] = None
+) -> dict:
+    """Read the export buffer: ``{cid: {"ts": last_update, "groups":
+    {"service@pid": {"service", "pid", "spans": [...]}}}}``, filtered
+    to one cid and/or to entries updated after ``since``. Reads do not
+    consume — eviction is the ring's job — so a stitcher retry sees
+    the same spans."""
+    with _EXPORT_LOCK:
+        cids = (
+            [correlation_id]
+            if correlation_id is not None
+            else list(_EXPORT_ORDER)
+        )
+        out = {}
+        for cid in cids:
+            entry = _EXPORT.get(cid)
+            if entry is None:
+                continue
+            if since is not None and entry["ts"] <= since:
+                continue
+            out[cid] = {
+                "ts": entry["ts"],
+                "groups": {
+                    proc: {
+                        "service": group["service"],
+                        "pid": group["pid"],
+                        "spans": list(group["spans"]),
+                    }
+                    for proc, group in entry["groups"].items()
+                },
+            }
+        return out

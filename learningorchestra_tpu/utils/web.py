@@ -72,6 +72,9 @@ class WebApp:
         self.name = name
         self.url_map = Map()
         self._handlers: dict[str, Callable] = {}
+        # set by register_observability(): the store whose __lo_metrics__
+        # ring backs /metrics/history, /debug/slo and /health's degraded
+        self._obs_store = None
         # Telemetry: every app reports into the process registry (one
         # shared registry when services co-habit a process — families
         # are labelled by service) and serves it at GET /metrics.
@@ -150,6 +153,137 @@ class WebApp:
                 mimetype="text/plain",
                 status=200,
             )
+
+        @self.route("/debug/spans")
+        def debug_spans(request):
+            """This process's span export buffer (telemetry/tracing.py)
+            — the per-member feed the fleet stitcher drains.
+            ``?cid=`` filters to one correlation ID, ``?since=`` to
+            entries updated after an epoch timestamp."""
+            cid = request.args.get("cid")
+            since = request.args.get("since")
+            if since is not None:
+                try:
+                    since = float(since)
+                except ValueError:
+                    return {"result": "bad_since"}, 400
+            return {"result": _tracing.exported_spans(cid, since)}, 200
+
+        @self.route("/traces/<cid>")
+        def read_stitched_trace(request, cid):
+            """ONE Chrome trace for one correlation ID, stitched across
+            every plane member in ``LO_PLANE_MEMBERS`` (telemetry/
+            stitch.py): one process row per ``service@pid``, so a
+            client-driven multi-service pipeline renders as a single
+            timeline. 404 when no member holds spans for the cid."""
+            from learningorchestra_tpu.telemetry import stitch as _stitch
+
+            trace = _stitch.stitched_trace(cid)
+            if not trace["otherData"]["processes"]:
+                return {"result": "not_found"}, 404
+            return trace, 200
+
+    def register_observability(self, store) -> None:
+        """The store-backed half of the fleet observability plane
+        (docs/observability.md "Fleet plane"):
+
+        - ``GET /metrics/history?family=…`` — the ``__lo_metrics__``
+          ring's fold-forward series plus server-side windowed rollups
+          (rate / p50 / p99 per instance — telemetry/tsdb.py);
+        - ``POST /metrics/ingest`` — raw Prometheus exposition text in,
+          one retention tick out (what deploy/cluster.py's collector
+          posts per scraped member);
+        - ``GET /debug/slo`` — ok/burning per SLO rule with the
+          offending instance (telemetry/slo.py); also arms ``/health``'s
+          ``degraded`` field.
+        """
+        from learningorchestra_tpu.telemetry import slo as _slo
+        from learningorchestra_tpu.telemetry import tsdb as _tsdb
+
+        self._obs_store = store
+        ingest_tsdb = _tsdb.TSDB(store)
+
+        @self.route("/metrics/history")
+        def metrics_history(request):
+            family = request.args.get("family")
+            if not family:
+                return {"result": "bad_family"}, 400
+            try:
+                since = (
+                    float(request.args["since"])
+                    if "since" in request.args
+                    else None
+                )
+                window_s = float(
+                    request.args.get("window", _slo.slo_window_s())
+                )
+            except ValueError:
+                return {"result": "bad_window"}, 400
+            instance = request.args.get("instance")
+            series = _tsdb.history(store, family, instance=instance)
+            return {
+                "result": {
+                    "family": family,
+                    "series": {
+                        inst: [
+                            [ts, value]
+                            for ts, value in points
+                            if since is None or ts >= since
+                        ]
+                        for inst, points in series.items()
+                    },
+                    "rollup": _tsdb.window_rollups(
+                        store, family, window_s=window_s, instance=instance
+                    ),
+                    "services": _tsdb.services_of(store),
+                }
+            }, 200
+
+        @self.route("/metrics/ingest", methods=("POST",))
+        def metrics_ingest(request):
+            body = request.get_json()
+            instance = body.get("instance")
+            text = body.get("text")
+            if not instance or not isinstance(text, str):
+                return {"result": "bad_ingest"}, 400
+            try:
+                vals = _tsdb.parse_samples(text)
+            except ValueError as error:
+                # a member scraped mid-restart: ITS tick is dropped,
+                # the collection stays consistent
+                return {"result": "unparseable", "error": str(error)}, 400
+            ingest_tsdb.append(
+                instance,
+                body.get("service") or "unknown",
+                vals,
+                ts=body.get("ts"),
+            )
+            return {"result": "ok", "families": len(vals)}, 200
+
+        @self.route("/debug/slo")
+        def debug_slo(request):
+            try:
+                return {"result": _slo.status(store)}, 200
+            except Exception as error:  # noqa: BLE001 — a store mid-
+                # failover must yield a diagnosable payload, not a 500
+                # traceback from the diagnosis endpoint itself
+                return {
+                    "result": "slo_unavailable",
+                    "error": f"{type(error).__name__}: {error}",
+                }, 503
+
+    def slo_degraded(self) -> bool:
+        """``/health``'s SLO verdict: True when any rule burns. False
+        without a registered store or on any evaluation error — health
+        must keep answering while the plane itself is sick."""
+        if self._obs_store is None:
+            return False
+        try:
+            from learningorchestra_tpu.telemetry import slo as _slo
+
+            return bool(_slo.status(self._obs_store)["degraded"])
+        except Exception:  # noqa: BLE001
+            return False
 
     def register_job_traces(self, jobs) -> None:
         """Serve ``GET /jobs/<name>/trace``: the span tree (with the
@@ -278,6 +412,10 @@ class WebApp:
                     # feature probe: client.py checks this once per
                     # cluster before preferring /wait over polling
                     "job_wait": True,
+                    # SLO verdict (telemetry/slo.py): liveness is not
+                    # healthiness — a serving replica can answer 200s
+                    # while its p99 burns
+                    "degraded": self.slo_degraded(),
                 }, 200
 
     def route(self, rule: str, methods: tuple[str, ...] = ("GET",)):
@@ -359,6 +497,9 @@ class WebApp:
                     )
         finally:
             self._in_flight.labels(self.name).dec()
+        # feed the cross-process stitcher: this request's spans land in
+        # the cid-keyed export buffer GET /debug/spans drains
+        _tracing.export_trace(trace, service=self.name)
         route = environ.get("lo.route", "<unmatched>")
         method = request.method
         if isinstance(response, Waiter):

@@ -810,3 +810,219 @@ class TestMetricsScrape:
         assert line.startswith("[cluster] metrics: members=2")
         assert "http_requests_total=7" in line
         assert "jobs_running=1" in line
+
+    def test_parse_prometheus_strict_raises_on_garbage(self):
+        cluster = _load_cluster_module()
+        with pytest.raises(ValueError):
+            cluster.parse_prometheus("garbage line without value\n", strict=True)
+        # lenient default unchanged: the garbage line is skipped
+        assert cluster.parse_prometheus("garbage line without value\n") == {}
+
+
+def _metrics_member(payload: bytes, content_length=None):
+    """A live /metrics member for scrape tests; returns (server, url).
+    ``content_length`` larger than the payload simulates a member dying
+    mid-response (the client sees a truncated body)."""
+    import http.server
+    import threading
+
+    declared = len(payload) if content_length is None else content_length
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(declared))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_port}"
+
+
+class TestScrapeRobustness:
+    def test_malformed_and_truncated_bodies_are_counted_skips(self):
+        """Regression: a member answering garbage (mid-restart, a proxy
+        error page) or a truncated body must be a per-member counted
+        skip — the healthy members' totals land untouched and the
+        scrape thread never crashes."""
+        cluster = _load_cluster_module()
+        healthy, healthy_url = _metrics_member(b"lo_jobs_running 2\n")
+        garbage, garbage_url = _metrics_member(b"garbage line without value\n")
+        binary, binary_url = _metrics_member(b"\x00\xff\xfe not text")
+        truncated, truncated_url = _metrics_member(
+            b"lo_jobs_running 9\n", content_length=4096
+        )
+        try:
+            totals, texts = cluster.scrape_member_metrics([
+                healthy_url, garbage_url, binary_url, truncated_url,
+                "http://127.0.0.1:9",  # nothing listening
+            ])
+        finally:
+            for server in (healthy, garbage, binary, truncated):
+                server.shutdown()
+                server.server_close()
+        assert totals["_members"] == 1
+        assert totals["_malformed"] == 2  # garbage + undecodable
+        assert totals["lo_jobs_running"] == 2.0  # healthy member only
+        assert list(texts) == [healthy_url]
+        line = cluster.metrics_summary_line(totals)
+        assert "members=1" in line and "malformed=2" in line
+
+    def test_push_member_metrics_lands_in_store_ring(self):
+        """Driver-side ingest push → the head store's retention ring:
+        the cluster-mode path that replaces per-process collectors."""
+        from learningorchestra_tpu.core.store import InMemoryStore
+        from learningorchestra_tpu.telemetry import tsdb
+        from learningorchestra_tpu.telemetry.metrics import MetricsRegistry
+        from learningorchestra_tpu.utils.web import ServerThread, WebApp
+
+        cluster = _load_cluster_module()
+        store = InMemoryStore()
+        app = WebApp("store", registry=MetricsRegistry())
+        app.register_observability(store)
+        server = ServerThread(app, "127.0.0.1", 0).start()
+        try:
+            store_url = f"http://127.0.0.1:{server.port}"
+            texts = {
+                "http://10.0.0.7:5002": "lo_jobs_total 4\n",
+                "http://10.0.0.7:27027": "lo_store_docs 11\n",
+            }
+            logs = []
+            pushed = cluster.push_member_metrics(
+                store_url, texts, log=logs.append
+            )
+            assert pushed == 2 and logs == []
+            history = tsdb.history(store, "lo_jobs_total")
+            assert [v for _, v in history["10.0.0.7:5002"]] == [4.0]
+            # the port → service map labels the instances
+            assert tsdb.services_of(store) == {
+                "10.0.0.7:5002": "model_builder",
+                "10.0.0.7:27027": "store",
+            }
+            # a dead store head: logged per member, never a raise
+            logs = []
+            assert cluster.push_member_metrics(
+                "http://127.0.0.1:9", {"http://h:5001": "lo_x 1\n"},
+                log=logs.append,
+            ) == 0
+            assert len(logs) == 1 and "push failed" in logs[0]
+        finally:
+            server.stop()
+
+
+class TestObservabilityManifest:
+    def test_tsdb_and_slo_sections_plumb_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["tsdb"] = {
+            "points": 128, "interval_s": 15, "trace_ring": 512,
+        }
+        manifest["slo"] = {
+            "window_s": 300, "serve_p99_s": 0.25, "http_5xx_rate": 1,
+            "queue_depth": 32, "replication_lag": 500,
+        }
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # every machine: one ring, one threshold set
+            env = plan["env"]
+            assert env["LO_TSDB_POINTS"] == "128"
+            assert env["LO_METRICS_INTERVAL_S"] == "15"
+            assert env["LO_TRACE_RING"] == "512"
+            assert env["LO_SLO_WINDOW_S"] == "300"
+            assert env["LO_SLO_SERVE_P99_S"] == "0.25"
+            assert env["LO_SLO_5XX_RATE"] == "1"
+            assert env["LO_SLO_QUEUE_DEPTH"] == "32"
+            assert env["LO_SLO_REPL_LAG"] == "500"
+
+    def test_driver_owns_collection_and_names_the_plane(self, tmp_path):
+        cluster = _load_cluster_module()
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(_manifest()))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:
+            env = plan["env"]
+            # the driver's scrape loop owns retention: fallback
+            # collectors off everywhere unless the manifest says so
+            assert env["LO_TSDB_COLLECT"] == "0"
+            members = env["LO_PLANE_MEMBERS"].split(",")
+            assert "http://10.0.0.1:27027" in members  # head store
+            assert "http://10.0.0.1:5002" in members  # model_builder
+            assert len(members) == 1 + len(cluster.SERVICE_PORTS)
+
+    def test_manifest_env_wins_over_defaults(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest(
+            env={"LO_TSDB_COLLECT": "1", "LO_PLANE_MEMBERS": "http://x:1"}
+        )
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:
+            assert plan["env"]["LO_TSDB_COLLECT"] == "1"
+            assert plan["env"]["LO_PLANE_MEMBERS"] == "http://x:1"
+
+    def test_tsdb_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(tsdb):
+            manifest = _manifest()
+            manifest["tsdb"] = tsdb
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # fractional scrape cadence is valid; integral knobs are strict
+        assert load({"interval_s": 0.5})["tsdb"]["interval_s"] == 0.5
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"points": 0})
+        with pytest.raises(SystemExit):
+            load({"points": 1.5})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"points": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"trace_ring": 0})
+        with pytest.raises(SystemExit):
+            load({"interval_s": 0})
+        with pytest.raises(SystemExit):
+            load({"interval_s": True})
+
+    def test_slo_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(slo):
+            manifest = _manifest()
+            manifest["slo"] = slo
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # 0 = alert on any breach: valid for the rate/latency objectives
+        assert load({"serve_p99_s": 0})["slo"]["serve_p99_s"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"window_s": 0})
+        with pytest.raises(SystemExit):
+            load({"serve_p99_s": -0.1})
+        with pytest.raises(SystemExit):
+            load({"queue_depth": 0})
+        with pytest.raises(SystemExit):
+            load({"queue_depth": 1.5})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"queue_depth": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"replication_lag": 0})
+        with pytest.raises(SystemExit):
+            load({"window_s": "600"})

@@ -137,3 +137,63 @@ def test_pretty_response_returns_json_string(stack, titanic_csv):
     listing = database.read_resume_files(pretty_response=True)
     assert isinstance(listing, str)
     assert "result" in json.loads(listing)
+
+
+@pytest.mark.integration
+def test_client_pipeline_yields_one_stitched_trace(stack, titanic_csv):
+    """Fleet observability acceptance: a client-driven ingest →
+    projection → histogram run, correlated by the ONE cid the SDK
+    Context mints, answers a single stitched Chrome trace at
+    GET /traces/<cid> with process rows from at least three services."""
+    import requests
+
+    context = Context("127.0.0.1")  # re-mint: one cid per pipeline run
+    cid = context.correlation_id
+    assert cid and lo_client.correlation_id == cid
+
+    database = DatabaseApi()
+    result = database.create_file(
+        "stitch_train", titanic_csv, pretty_response=False
+    )
+    assert result == {"result": "file_created"}
+    projection = Projection()
+    result = projection.create_projection(
+        "stitch_train", "stitch_proj",
+        ["PassengerId", "Survived", "Pclass", "Sex"],
+        pretty_response=False,
+    )
+    assert result == {"result": "created_file"}
+    histogram = Histogram()
+    result = histogram.create_histogram(
+        "stitch_proj", "stitch_hist", ["Sex"], pretty_response=False
+    )
+    assert result == {"result": "created_file"}
+
+    base = f"{lo_client.cluster_url}:{DatabaseApi.DATABASE_API_PORT}"
+    # every SDK request rides the minted cid; the middleware echoes it
+    probe = requests.get(
+        f"{base}/health", headers=lo_client._correlation_headers(),
+        timeout=5,
+    )
+    assert probe.headers.get("X-Correlation-Id") == cid
+
+    response = requests.get(f"{base}/traces/{cid}", timeout=10)
+    assert response.status_code == 200
+    trace = response.json()
+    assert trace["otherData"]["correlation_id"] == cid
+    processes = trace["otherData"]["processes"]
+    services = {proc.split("@", 1)[0] for proc in processes.values()}
+    assert {"database_api", "projection", "histogram"} <= services
+    assert len(processes) >= 3
+    # golden layout: one M process_name row per group, X events
+    # anchored to the shared t0
+    named = {
+        event["args"]["name"]
+        for event in trace["traceEvents"]
+        if event.get("ph") == "M" and event["name"] == "process_name"
+    }
+    assert named == set(processes.values())
+    complete = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert complete
+    assert min(event["ts"] for event in complete) == 0.0
+    assert all(event["dur"] >= 0 for event in complete)

@@ -1,0 +1,630 @@
+"""Fleet observability plane (docs/observability.md "Fleet plane"):
+in-store time-series retention (telemetry/tsdb.py + the store's trim
+primitive), SLO rule evaluation and its chaos visibility
+(telemetry/slo.py), and cross-process trace stitching
+(telemetry/tracing.py export buffer + telemetry/stitch.py)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from learningorchestra_tpu.core.jobs import JobManager
+from learningorchestra_tpu.core.store import InMemoryStore
+from learningorchestra_tpu.serve.batcher import LATENCY_BUCKETS, MicroBatcher
+from learningorchestra_tpu.telemetry import slo, stitch, tracing, tsdb
+from learningorchestra_tpu.telemetry.metrics import (
+    MetricsRegistry,
+    global_registry,
+)
+from learningorchestra_tpu.testing import faults
+from learningorchestra_tpu.utils.web import WebApp
+
+
+def body(response):
+    return json.loads(response.get_data())
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    # local-only stitching: no accidental HTTP fan-out from /traces/<cid>
+    monkeypatch.delenv("LO_PLANE_MEMBERS", raising=False)
+    yield
+    faults.reset()
+    with tracing._EXPORT_LOCK:
+        tracing._EXPORT.clear()
+        tracing._EXPORT_ORDER.clear()
+    with slo._STATUS_LOCK:
+        slo._STATUS_CACHE.clear()
+
+
+# --- exposition parsing ------------------------------------------------------
+
+class TestParseSamples:
+    def test_counters_sum_across_label_sets(self):
+        vals = tsdb.parse_samples(
+            "# HELP lo_jobs_total jobs\n"
+            "# TYPE lo_jobs_total counter\n"
+            'lo_jobs_total{service="a"} 7\n'
+            'lo_jobs_total{service="b"} 2\n'
+            "lo_jobs_running 3\n"
+        )
+        assert vals["lo_jobs_total"] == 9.0
+        assert vals["lo_jobs_running"] == 3.0
+
+    def test_5xx_derived_from_status_labels(self):
+        vals = tsdb.parse_samples(
+            'lo_http_requests_total{service="a",route="/x",status="200"} 7\n'
+            'lo_http_requests_total{service="a",route="/x",status="500"} 2\n'
+            'lo_http_requests_total{service="b",route="/y",status="503"} 1\n'
+        )
+        assert vals["lo_http_requests_total"] == 10.0
+        assert vals[tsdb.DERIVED_5XX] == 3.0
+
+    def test_5xx_zero_when_no_errors(self):
+        # the derived family must EXIST at 0.0 so the SLO rate rule has
+        # a baseline, not a missing series
+        vals = tsdb.parse_samples(
+            'lo_http_requests_total{status="200"} 7\n'
+        )
+        assert vals[tsdb.DERIVED_5XX] == 0.0
+
+    def test_histogram_family_merges_label_sets(self):
+        vals = tsdb.parse_samples(
+            'lo_x_seconds_bucket{route="a",le="0.1"} 3\n'
+            'lo_x_seconds_bucket{route="a",le="+Inf"} 4\n'
+            'lo_x_seconds_bucket{route="b",le="0.1"} 1\n'
+            'lo_x_seconds_bucket{route="b",le="+Inf"} 1\n'
+            "lo_x_seconds_sum 0.9\n"
+            "lo_x_seconds_count 5\n"
+        )
+        assert vals["lo_x_seconds"] == {
+            "buckets": {"0.1": 4.0, "+Inf": 5.0},
+            "sum": 0.9,
+            "count": 5.0,
+        }
+
+    def test_malformed_bodies_raise(self):
+        with pytest.raises(ValueError):
+            tsdb.parse_samples("garbage line without value")
+        with pytest.raises(ValueError):
+            tsdb.parse_samples("lo_x 1e")  # truncated value token
+        with pytest.raises(ValueError):
+            tsdb.parse_samples('lo_x_bucket{route="a"} 3')  # bucket, no le
+
+    def test_comments_and_blanks_skipped(self):
+        assert tsdb.parse_samples("# only comments\n\n") == {}
+
+
+# --- store trim primitive ----------------------------------------------------
+
+class TestTrimCollection:
+    def test_oldest_first_and_rev_bump(self, store):
+        for i in range(10):
+            store.insert_one("ring", {"v": i})
+        rev_before = store.collection_rev("ring")
+        assert store.trim_collection("ring", 4) == 6
+        assert sorted(doc["v"] for doc in store.find("ring")) == [6, 7, 8, 9]
+        assert store.collection_rev("ring") > rev_before
+
+    def test_noop_under_cap(self, store):
+        for i in range(3):
+            store.insert_one("ring", {"v": i})
+        rev = store.collection_rev("ring")
+        assert store.trim_collection("ring", 10) == 0
+        assert store.collection_rev("ring") == rev
+        assert store.trim_collection("missing", 5) == 0
+
+    def test_rejects_bool_float_negative(self, store):
+        for bad in (True, False, 2.0, -1):
+            with pytest.raises(ValueError):
+                store.trim_collection("ring", bad)
+
+    def test_wal_replays_the_trim(self, tmp_path):
+        durable = InMemoryStore(data_dir=str(tmp_path))
+        for i in range(6):
+            durable.insert_one("ring", {"v": i})
+        assert durable.trim_collection("ring", 2) == 4
+        reopened = InMemoryStore(data_dir=str(tmp_path))
+        assert sorted(doc["v"] for doc in reopened.find("ring")) == [4, 5]
+
+
+# --- TSDB retention + rollups ------------------------------------------------
+
+class TestTSDB:
+    def test_ring_cap_evicts_oldest_first(self, store):
+        db = tsdb.TSDB(store, points=3)
+        for i in range(5):
+            db.append("m1", "svc", {"lo_g": float(i)}, ts=1000.0 + 60 * i)
+        docs = sorted(store.find(tsdb.COLLECTION), key=lambda d: d["ts"])
+        assert [doc["ts"] for doc in docs] == [1120.0, 1180.0, 1240.0]
+
+    def test_budget_scales_with_instances(self, store):
+        db = tsdb.TSDB(store, points=2)
+        for i in range(3):
+            db.append("m1", "a", {"x": float(i)}, ts=100.0 * i)
+            db.append("m2", "b", {"x": float(i)}, ts=100.0 * i + 1)
+        docs = list(store.find(tsdb.COLLECTION))
+        assert len(docs) == 4  # 2 points x 2 instances
+        per_instance = {
+            inst: sorted(d["ts"] for d in docs if d["instance"] == inst)
+            for inst in ("m1", "m2")
+        }
+        assert per_instance == {"m1": [100.0, 200.0], "m2": [101.0, 201.0]}
+
+    def test_delta_compression_and_fold_forward(self, store):
+        db = tsdb.TSDB(store)
+        db.append("m1", "svc", {"a": 1.0, "b": 2.0}, ts=0.0)
+        db.append("m1", "svc", {"a": 1.0, "b": 3.0}, ts=60.0)
+        docs = sorted(store.find(tsdb.COLLECTION), key=lambda d: d["ts"])
+        assert docs[0]["vals"] == {"a": 1.0, "b": 2.0}
+        assert docs[1]["vals"] == {"b": 3.0}  # only the changed family
+        # readers undo the compression: unchanged ticks carry the value
+        assert tsdb.history(store, "a")["m1"] == [(0.0, 1.0), (60.0, 1.0)]
+        assert tsdb.history(store, "b")["m1"] == [(0.0, 2.0), (60.0, 3.0)]
+
+    def test_counter_rate_golden(self, store):
+        db = tsdb.TSDB(store)
+        for tick, total in ((0.0, 0.0), (60.0, 60.0), (120.0, 120.0)):
+            db.append("m1", "svc", {"lo_c_total": total}, ts=tick)
+        points = tsdb.history(store, "lo_c_total")["m1"]
+        rolled = tsdb.rollup("lo_c_total", points, window_s=120.0, now=120.0)
+        assert rolled["delta"] == 120.0
+        assert rolled["rate"] == 1.0  # 120 increments over a 120 s span
+
+    def test_counter_reset_falls_back_to_post_restart_totals(self, store):
+        db = tsdb.TSDB(store)
+        for tick, total in ((0.0, 100.0), (60.0, 120.0), (120.0, 5.0)):
+            db.append("m1", "svc", {"lo_c_total": total}, ts=tick)
+        points = tsdb.history(store, "lo_c_total")["m1"]
+        rolled = tsdb.rollup("lo_c_total", points, window_s=120.0, now=120.0)
+        assert rolled["delta"] == 5.0  # not -95
+
+    def test_histogram_p99_golden(self, store):
+        db = tsdb.TSDB(store)
+        zero = {
+            "buckets": {"0.1": 0.0, "1.0": 0.0, "+Inf": 0.0},
+            "sum": 0.0,
+            "count": 0.0,
+        }
+        later = {
+            "buckets": {"0.1": 90.0, "1.0": 100.0, "+Inf": 100.0},
+            "sum": 30.0,
+            "count": 100.0,
+        }
+        db.append("m1", "svc", {"lo_h_seconds": zero}, ts=0.0)
+        db.append("m1", "svc", {"lo_h_seconds": later}, ts=60.0)
+        points = tsdb.history(store, "lo_h_seconds")["m1"]
+        rolled = tsdb.rollup("lo_h_seconds", points, window_s=60.0, now=60.0)
+        assert rolled["count"] == 100.0
+        assert rolled["mean"] == 0.3
+        # histogram_quantile interpolation: rank 99 lands in (0.1, 1.0]
+        assert rolled["p99"] == pytest.approx(0.91)
+        assert rolled["p50"] == pytest.approx(0.055556)
+
+    def test_restart_reseeds_without_redump_and_revs_advance(self, store):
+        first = tsdb.TSDB(store)
+        first.append("m1", "svc", {"a": 1.0, "b": 2.0}, ts=0.0)
+        rev_before = store.collection_rev(tsdb.COLLECTION)
+        # a NEW appender over the same store = a restarted collector
+        second = tsdb.TSDB(store)
+        second.append("m1", "svc", {"a": 1.0, "b": 2.0}, ts=60.0)
+        docs = sorted(store.find(tsdb.COLLECTION), key=lambda d: d["ts"])
+        assert docs[1]["vals"] == {}  # reseeded: no spurious full redump
+        assert store.collection_rev(tsdb.COLLECTION) > rev_before
+        second.append("m1", "svc", {"a": 1.0, "b": 5.0}, ts=120.0)
+        # fold-forward continuity across the restart boundary
+        assert tsdb.history(store, "b")["m1"] == [
+            (0.0, 2.0), (60.0, 2.0), (120.0, 5.0),
+        ]
+
+    def test_collector_scrapes_registry(self, store):
+        registry = MetricsRegistry()
+        jobs = registry.counter("lo_jobs_total", "jobs")
+        jobs.inc(4)
+        collector = tsdb.Collector(
+            store, registry, instance="r1", service="runner",
+            interval_s=3600,
+        )
+        collector.collect_once(ts=1000.0)
+        jobs.inc(2)
+        collector.collect_once(ts=1060.0)
+        assert collector.ticks == 2 and collector.errors == 0
+        assert tsdb.history(store, "lo_jobs_total")["r1"] == [
+            (1000.0, 4.0), (1060.0, 6.0),
+        ]
+
+    def test_collector_counts_and_swallows_failures(self, store):
+        class _BrokenRegistry:
+            def render(self):
+                raise RuntimeError("scrape exploded")
+
+        collector = tsdb.Collector(
+            store, _BrokenRegistry(), instance="r1", service="runner",
+            interval_s=3600,
+        )
+        collector.collect_once(ts=1000.0)  # must not raise
+        assert collector.ticks == 0 and collector.errors == 1
+
+
+# --- SLO rules ---------------------------------------------------------------
+
+class TestSLO:
+    def test_scripted_burn_and_clear(self, store):
+        db = tsdb.TSDB(store)
+        db.append(
+            "sched1", "runner", {"lo_sched_queue_depth": 100.0}, ts=1000.0
+        )
+        result = slo.evaluate(store, now=1000.0)
+        assert result["burning"] == ["sched_queue_depth"]
+        assert result["degraded"] is True
+        entry = next(
+            r for r in result["rules"] if r["rule"] == "sched_queue_depth"
+        )
+        assert entry["value"] == 100.0 and entry["instance"] == "sched1"
+        db.append(
+            "sched1", "runner", {"lo_sched_queue_depth": 3.0}, ts=1060.0
+        )
+        result = slo.evaluate(store, now=1060.0)
+        assert result["burning"] == [] and result["degraded"] is False
+
+    def test_fault_latency_flips_exactly_one_rule(self, store, monkeypatch):
+        """The chaos-visibility loop: an injected serve.forward latency
+        fault must surface as the serve_p99 rule burning — and ONLY that
+        rule — then clear once the fault is disarmed and the window
+        slides past the slow burst."""
+        monkeypatch.setenv("LO_SLO_SERVE_P99_S", "0.02")
+
+        class _FakeModel:
+            def predict_both(self, X):
+                return (
+                    np.zeros(len(X), np.int64),
+                    np.zeros((len(X), 2), np.float32),
+                )
+
+        class _InstantRegistry:
+            def get(self, path):
+                return _FakeModel()
+
+        registry = MetricsRegistry()
+        # the route-level histogram model_builder observes into
+        serve_seconds = registry.histogram(
+            "lo_serve_request_seconds", "test latency",
+            buckets=LATENCY_BUCKETS,
+        )
+        collector = tsdb.Collector(
+            store, registry, instance="serve1", service="model_builder",
+            interval_s=3600,
+        )
+        batcher = MicroBatcher(
+            _InstantRegistry(), window_s=0.0, max_batch=4, inbox_cap=8
+        )
+        try:
+            faults.install("serve.forward", "delay:0.08")
+            started = time.perf_counter()
+            request = batcher.submit("m", np.zeros((1, 2)))
+            assert request.wait(10.0) and request.error is None
+            elapsed = time.perf_counter() - started
+            assert elapsed >= 0.08  # the fault really delayed the forward
+            serve_seconds.observe(elapsed)
+            collector.collect_once(ts=1000.0)
+            status = slo.evaluate(store, now=1000.0)
+            assert status["burning"] == ["serve_p99"]  # exactly one rule
+            # heal: disarm the fault, fast traffic, window slides on
+            faults.reset()
+            for _ in range(50):
+                serve_seconds.observe(0.001)
+            collector.collect_once(ts=1400.0)
+            collector.collect_once(ts=2000.0)
+            status = slo.evaluate(store, now=2000.0)
+            assert status["burning"] == []
+            assert status["degraded"] is False
+        finally:
+            batcher.close()
+
+    def test_status_cached_per_rev(self, store):
+        db = tsdb.TSDB(store)
+        db.append("i1", "svc", {"lo_g": 1.0}, ts=100.0)
+        first = slo.status(store)
+        assert slo.status(store) is first  # same rev: cached verbatim
+        db.append("i1", "svc", {"lo_g": 2.0}, ts=160.0)
+        assert slo.status(store) is not first  # rev moved: re-evaluated
+
+    def test_debug_slo_and_health_degraded_routes(self, store):
+        app = WebApp("obs", registry=MetricsRegistry())
+        app.register_job_routes(JobManager())
+        app.register_observability(store)
+        client = app.test_client()
+        db = tsdb.TSDB(store)
+        db.append(
+            "sched1", "runner", {"lo_sched_queue_depth": 100.0}, ts=1000.0
+        )
+        payload = body(client.get("/debug/slo"))["result"]
+        assert payload["degraded"] is True
+        assert payload["burning"] == ["sched_queue_depth"]
+        assert body(client.get("/health"))["degraded"] is True
+        db.append(
+            "sched1", "runner", {"lo_sched_queue_depth": 1.0}, ts=1060.0
+        )
+        assert body(client.get("/health"))["degraded"] is False
+
+    def test_burning_gauge_published(self, store):
+        db = tsdb.TSDB(store)
+        db.append(
+            "sched1", "runner", {"lo_sched_queue_depth": 100.0}, ts=1000.0
+        )
+        slo.publish(store, now=1000.0)
+
+        def gauge_value():
+            for line in global_registry().render().splitlines():
+                if line.startswith("lo_slo_burning") and (
+                    'rule="sched_queue_depth"' in line
+                ):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError("lo_slo_burning gauge not rendered")
+
+        assert gauge_value() == 1.0
+        db.append(
+            "sched1", "runner", {"lo_sched_queue_depth": 1.0}, ts=1060.0
+        )
+        slo.publish(store, now=1060.0)
+        assert gauge_value() == 0.0
+
+
+# --- trace stitching ---------------------------------------------------------
+
+def _export_one(cid, service, names=("alpha",)):
+    trace = tracing.Trace(cid)
+    with tracing.activate(trace):
+        for name in names:
+            with tracing.span(name):
+                pass
+    tracing.export_trace(trace, service=service)
+    return trace
+
+
+class TestStitch:
+    def test_golden_stitched_fields(self):
+        pid = os.getpid()
+        trace = tracing.Trace("cid_golden_1")
+        with tracing.activate(trace):
+            with tracing.span("alpha"):
+                with tracing.span("alpha:child"):
+                    pass
+        tracing.export_trace(trace, service="svc_a")
+        _export_one("cid_golden_1", "svc_b", names=("beta",))
+        out = stitch.stitched_trace("cid_golden_1", members=[])
+        assert out["displayTimeUnit"] == "ms"
+        assert out["otherData"]["correlation_id"] == "cid_golden_1"
+        # deterministic layout: sorted group keys -> synthetic pids
+        assert out["otherData"]["processes"] == {
+            0: f"svc_a@{pid}", 1: f"svc_b@{pid}",
+        }
+        names = {
+            event["args"]["name"]
+            for event in out["traceEvents"]
+            if event["ph"] == "M" and event["name"] == "process_name"
+        }
+        assert names == {f"svc_a@{pid}", f"svc_b@{pid}"}
+        complete = [e for e in out["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in complete} == {
+            "alpha", "alpha:child", "beta",
+        }
+        # all events anchored to one shared t0: the earliest span is 0
+        assert min(e["ts"] for e in complete) == 0.0
+        assert all(e["dur"] >= 0 for e in complete)
+
+    def test_fanout_merges_remote_groups(self, monkeypatch):
+        _export_one("cid_fanout", "svc_local")
+        remote_group = {
+            "svc_remote@9999": {
+                "service": "svc_remote",
+                "pid": 9999,
+                "spans": [{
+                    "name": "remote_work", "start_ts": 10.0,
+                    "duration_s": 0.5, "tid": 1, "children": [],
+                }],
+            }
+        }
+        calls = []
+
+        def fake_fetch(base_url, cid, since=None):
+            calls.append((base_url, cid, since))
+            return remote_group
+
+        monkeypatch.setattr(stitch, "fetch_member_spans", fake_fetch)
+        out = stitch.stitched_trace(
+            "cid_fanout", members=["http://remote:1"]
+        )
+        assert calls == [("http://remote:1", "cid_fanout", None)]
+        assert set(out["otherData"]["processes"].values()) == {
+            f"svc_local@{os.getpid()}", "svc_remote@9999",
+        }
+        assert any(
+            e["name"] == "remote_work" for e in out["traceEvents"]
+        )
+
+    def test_fanout_dedupes_own_group(self, monkeypatch):
+        """A member list naming the serving process itself must replace
+        the identical local group, not duplicate the row."""
+        _export_one("cid_dedupe", "svc_self")
+        key = f"svc_self@{os.getpid()}"
+        local = tracing.exported_spans("cid_dedupe")["cid_dedupe"]
+
+        monkeypatch.setattr(
+            stitch, "fetch_member_spans",
+            lambda base_url, cid, since=None: dict(local["groups"]),
+        )
+        out = stitch.stitched_trace("cid_dedupe", members=["http://me:1"])
+        assert list(out["otherData"]["processes"].values()) == [key]
+
+    def test_fetch_skips_down_member(self):
+        # nothing listens on port 1: a partial stitch, never a raise
+        assert stitch.fetch_member_spans("http://127.0.0.1:1", "cid") == {}
+
+    def test_trace_ring_bounds_export_buffer(self, monkeypatch):
+        monkeypatch.setenv("LO_TRACE_RING", "2")
+        for i in range(3):
+            _export_one(f"cid_ring_{i}", "svc")
+        assert tracing.exported_spans("cid_ring_0") == {}  # evicted
+        assert "cid_ring_2" in tracing.exported_spans()
+        trace = tracing.Trace("cid_ring_many")
+        with tracing.activate(trace):
+            for _ in range(5):
+                with tracing.span("s"):
+                    pass
+        tracing.export_trace(trace, service="svc")
+        groups = tracing.exported_spans("cid_ring_many")[
+            "cid_ring_many"]["groups"]
+        assert len(groups[f"svc@{os.getpid()}"]["spans"]) == 2
+
+    def test_trace_ring_knob_rejects_bad_values(self, monkeypatch):
+        for bad in ("0", "-3", "1.5", "yes"):
+            monkeypatch.setenv("LO_TRACE_RING", bad)
+            with pytest.raises(ValueError):
+                tracing.trace_ring()
+        monkeypatch.setenv("LO_TRACE_RING", "7")
+        assert tracing.trace_ring() == 7
+
+    def test_debug_spans_route(self):
+        _export_one("cid_route_1", "svc_r")
+        app = WebApp("obs", registry=MetricsRegistry())
+        client = app.test_client()
+        payload = body(client.get("/debug/spans?cid=cid_route_1"))["result"]
+        groups = payload["cid_route_1"]["groups"]
+        assert f"svc_r@{os.getpid()}" in groups
+        assert client.get("/debug/spans?since=nope").status_code == 400
+        # a since in the future filters everything out
+        future = time.time() + 3600
+        assert body(
+            client.get(f"/debug/spans?cid=cid_route_1&since={future}")
+        )["result"] == {}
+
+    def test_traces_route(self):
+        app = WebApp("obs", registry=MetricsRegistry())
+        client = app.test_client()
+        assert client.get("/traces/unknown_cid").status_code == 404
+        _export_one("cid_route_2", "svc_t")
+        payload = body(client.get("/traces/cid_route_2"))
+        assert payload["otherData"]["correlation_id"] == "cid_route_2"
+        assert payload["otherData"]["processes"]
+
+    def test_remember_ring_honours_knob(self, monkeypatch):
+        monkeypatch.setenv("LO_TRACE_RING", "2")
+        for i in range(3):
+            tracing.remember_trace(tracing.Trace(f"cid_recall_{i}"))
+        assert tracing.recall_trace("cid_recall_0") is None
+        assert tracing.recall_trace("cid_recall_2") is not None
+
+
+# --- /metrics/history + ingest -----------------------------------------------
+
+class TestHistoryRoute:
+    def _app(self, store):
+        registry = MetricsRegistry()
+        app = WebApp("obs", registry=registry)
+        app.register_observability(store)
+        return app, registry
+
+    def test_p99_after_burst(self, store):
+        """The acceptance-shaped read: a latency burst lands in the
+        retention ring and GET /metrics/history answers a non-empty
+        windowed p99 for lo_serve_request_seconds."""
+        app, registry = self._app(store)
+        serve_seconds = registry.histogram(
+            "lo_serve_request_seconds", "t", buckets=LATENCY_BUCKETS
+        )
+        for _ in range(90):
+            serve_seconds.observe(0.001)
+        for _ in range(10):
+            serve_seconds.observe(0.2)
+        collector = tsdb.Collector(
+            store, registry, instance="serve1", service="model_builder",
+            interval_s=3600,
+        )
+        collector.collect_once(ts=1000.0)
+        assert collector.ticks == 1 and collector.errors == 0
+        payload = body(app.test_client().get(
+            "/metrics/history?family=lo_serve_request_seconds"
+        ))["result"]
+        rolled = payload["rollup"]["serve1"]
+        assert rolled["count"] == 100.0
+        assert rolled["p99"] > 0.1  # the slow tail is visible
+        assert payload["series"]["serve1"]
+        assert payload["services"]["serve1"] == "model_builder"
+
+    def test_since_filter_and_bad_args(self, store):
+        app, _ = self._app(store)
+        client = app.test_client()
+        db = tsdb.TSDB(store)
+        db.append("m1", "svc", {"lo_g": 1.0}, ts=100.0)
+        db.append("m1", "svc", {"lo_g": 2.0}, ts=200.0)
+        payload = body(client.get(
+            "/metrics/history?family=lo_g&since=150"
+        ))["result"]
+        assert payload["series"]["m1"] == [[200.0, 2.0]]
+        assert client.get("/metrics/history").status_code == 400
+        assert client.get(
+            "/metrics/history?family=lo_g&window=abc"
+        ).status_code == 400
+
+    def test_ingest_roundtrip(self, store):
+        app, _ = self._app(store)
+        client = app.test_client()
+        response = client.post("/metrics/ingest", json={
+            "instance": "10.0.0.7:5002", "service": "model_builder",
+            "text": "lo_jobs_total 4\n", "ts": 1000.0,
+        })
+        assert response.status_code == 200
+        assert body(response)["families"] == 1
+        assert tsdb.history(store, "lo_jobs_total")["10.0.0.7:5002"] == [
+            (1000.0, 4.0),
+        ]
+        assert tsdb.services_of(store) == {"10.0.0.7:5002": "model_builder"}
+
+    def test_ingest_rejects_bad_bodies(self, store):
+        app, _ = self._app(store)
+        client = app.test_client()
+        assert client.post(
+            "/metrics/ingest", json={"text": "x 1\n"}
+        ).status_code == 400
+        response = client.post("/metrics/ingest", json={
+            "instance": "i", "text": "garbage line without value\n",
+        })
+        assert response.status_code == 400
+        assert body(response)["result"] == "unparseable"
+        assert list(store.find(tsdb.COLLECTION)) == []  # nothing landed
+
+
+# --- SDK correlation ---------------------------------------------------------
+
+class TestClientCorrelation:
+    def test_context_mints_one_cid_per_run(self):
+        import learningorchestra_tpu.client as lo_client
+
+        saved_cid = lo_client.correlation_id
+        saved_url = getattr(lo_client, "cluster_url", None)
+        try:
+            first = lo_client.Context("10.0.0.1")
+            assert first.correlation_id
+            assert lo_client._correlation_headers() == {
+                "X-Correlation-Id": first.correlation_id
+            }
+            second = lo_client.Context("10.0.0.1")
+            assert second.correlation_id != first.correlation_id
+        finally:
+            lo_client.correlation_id = saved_cid
+            if saved_url is not None:
+                lo_client.cluster_url = saved_url
+
+    def test_no_header_without_context(self):
+        import learningorchestra_tpu.client as lo_client
+
+        saved_cid = lo_client.correlation_id
+        try:
+            lo_client.correlation_id = None
+            assert lo_client._correlation_headers() == {}
+        finally:
+            lo_client.correlation_id = saved_cid
