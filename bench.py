@@ -1406,6 +1406,167 @@ def bench_mfu() -> dict:
     }
 
 
+def _coldstart_child() -> None:
+    """Child entry for the coldstart section (run via ``python -c``).
+
+    Enables the persistent jit cache at ``LO_COLDSTART_CACHE_DIR``,
+    optionally pulls the fleet executable collection from
+    ``LO_COLDSTART_STORE_URL`` first, then compiles one program per
+    family (predict / build / sweep) off the shared manifest and prints
+    ONE JSON line: per-program first-compile seconds plus this
+    process's persistent-cache hit/miss counters. The parent decides
+    what the numbers mean (cold vs warm vs fleet-fetched)."""
+    cache_dir = os.environ["LO_COLDSTART_CACHE_DIR"]
+    store_url = os.environ.get("LO_COLDSTART_STORE_URL")
+
+    from learningorchestra_tpu.utils import jitcache
+
+    jitcache.enable_compile_cache(cache_dir)
+
+    fetch_stats = {"fetched": 0, "discarded": 0, "skipped": 0}
+    if store_url:
+        from learningorchestra_tpu.compile import fleetcache
+        from learningorchestra_tpu.core.store_service import RemoteStore
+
+        client = RemoteStore(store_url)
+        try:
+            fetch_stats = fleetcache.fetch(client, cache_dir)
+        finally:
+            client.close()
+
+    from learningorchestra_tpu.compile import aot, manifest
+    from learningorchestra_tpu.ml.base import resolve_mesh
+
+    mesh = resolve_mesh(None)
+    kept, _ = manifest.enumerate_programs(mesh)
+    picks: dict = {}
+    for spec in kept:
+        if spec.program == "build:lr" and "build" not in picks:
+            picks["build"] = spec
+        elif spec.program == "predict:lr" and "predict" not in picks:
+            picks["predict"] = spec
+        elif spec.program == "sweep:lr" and "sweep" not in picks:
+            picks["sweep"] = spec
+    programs = {}
+    for family, spec in sorted(picks.items()):
+        start = time.perf_counter()
+        aot.compile_spec(spec, source="jit")  # the request path's bill
+        programs[f"first_{family}_s"] = round(
+            time.perf_counter() - start, 4
+        )
+    print(
+        json.dumps(
+            {
+                "programs": programs,
+                "fetch": fetch_stats,
+                "cache": jitcache.cache_stats(),
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_coldstart() -> dict:
+    """Coldstart section: what the AOT compile plane (docs/compile.md)
+    buys a fresh process. Three child-process arms compile the same
+    manifest programs: ``cold`` against an empty persistent cache (the
+    pre-plane first-request bill), ``warm`` against the dir the cold
+    arm just filled (same-machine restart), and ``fleet`` against a
+    fresh dir after fetching the executables the cold arm's files were
+    published to a store as (a brand-new runner joining a warmed
+    fleet). The headline assertion: the fleet arm's compile-miss count
+    is ~0 — a fresh runner never pays the grid's compile bill twice
+    fleet-wide."""
+    import subprocess
+    import sys
+    import tempfile
+
+    import shutil
+
+    from learningorchestra_tpu.compile import fleetcache
+    from learningorchestra_tpu.core.store import InMemoryStore
+    from learningorchestra_tpu.core.store_service import (
+        RemoteStore,
+        create_store_app,
+    )
+    from learningorchestra_tpu.utils.web import ServerThread
+
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def run_child(cache_dir: str, store_url: Optional[str] = None) -> dict:
+        env = dict(os.environ, LO_COLDSTART_CACHE_DIR=cache_dir)
+        env.pop("LO_JIT_CACHE", None)  # the child's dir must win
+        if store_url:
+            env["LO_COLDSTART_STORE_URL"] = store_url
+        proc = subprocess.run(
+            [sys.executable, "-c", "import bench; bench._coldstart_child()"],
+            cwd=here,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"coldstart child failed: {proc.stderr.strip()[-500:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold_dir = tempfile.mkdtemp(prefix="lo_coldstart_cold_")
+    fleet_dir = tempfile.mkdtemp(prefix="lo_coldstart_fleet_")
+    store = InMemoryStore()
+    server = ServerThread(create_store_app(store), "127.0.0.1", 0).start()
+    out: dict = {}
+    try:
+        cold = run_child(cold_dir)
+        out["cold"] = {
+            **cold["programs"],
+            "misses": cold["cache"]["persistent_cache_misses"],
+        }
+
+        if _budget_left() < 60:
+            out["warm"] = out["fleet"] = {"skipped": "budget"}
+            return out
+        warm = run_child(cold_dir)  # same dir: the restart case
+        out["warm"] = {
+            **warm["programs"],
+            "hits": warm["cache"]["persistent_cache_hits"],
+        }
+        for family in ("build", "predict", "sweep"):
+            key = f"first_{family}_s"
+            if key in cold["programs"] and key in warm["programs"]:
+                out[f"cold_vs_warm_{family}_delta_s"] = round(
+                    cold["programs"][key] - warm["programs"][key], 4
+                )
+
+        if _budget_left() < 60:
+            out["fleet"] = {"skipped": "budget"}
+            return out
+        # publish the cold arm's cache files through the store, then a
+        # THIRD process with an empty local dir fetches and replays
+        client = RemoteStore(f"http://127.0.0.1:{server.port}")
+        try:
+            published = fleetcache.publish(client, cold_dir)
+        finally:
+            client.close()
+        fleet = run_child(
+            fleet_dir, store_url=f"http://127.0.0.1:{server.port}"
+        )
+        out["fleet"] = {
+            **fleet["programs"],
+            "fetched": fleet["fetch"]["fetched"],
+            "published": published["published"],
+            # the plane's contract: ~0 — every program came off the wire
+            "compile_misses": fleet["cache"]["persistent_cache_misses"],
+            "compile_hits": fleet["cache"]["persistent_cache_hits"],
+        }
+        return out
+    finally:
+        server.stop()
+        shutil.rmtree(cold_dir, ignore_errors=True)
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+
+
 # --- regression gate (--compare) ---------------------------------------------
 # The machinery that would have caught and localized the tsne_landmark
 # regression the day it happened: diff every reported metric and
@@ -1651,6 +1812,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
     section("waiters", bench_waiters)  # push job completion (docs/web.md)
     section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch
     section("obs", lambda: bench_obs(X, y))  # fleet plane's own cost
+    section("coldstart", bench_coldstart)  # AOT plane's cold-start win
     section("embeddings", bench_embeddings)
     section("kernels_wide", bench_kernels_wide)
 

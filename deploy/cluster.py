@@ -81,6 +81,11 @@ Manifest (JSON)::
         "enabled": 1,              #   LO_RESUME (0 = orphaned RUNNING
         "every_segments": 1        #   jobs fail on restart) / LO_RESUME_
       },                           #   EVERY_SEGMENTS (integer >= 1)
+      "compile": {                 # optional AOT compile plane knobs
+        "aot": 1,                  #   LO_AOT (1 = precompile the shape
+        "max_programs": 64,        #   grid at boot) / LO_AOT_MAX_
+        "publish": 1               #   PROGRAMS (integer >= 0) /
+      },                           #   LO_AOT_PUBLISH (docs/compile.md)
       "replication": {             # optional replicated store plane
         "enabled": true,           #   (docs/replication.md): the head
         "follower_port": 27028,    #   runs primary + WAL-shipping
@@ -294,6 +299,24 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("resume.enabled must be 0 or 1")
         elif value < 1:  # every_segments
             raise SystemExit("resume.every_segments must be >= 1")
+    compile_knobs = manifest.setdefault("compile", {})
+    for key in compile_knobs:
+        if key not in _COMPILE_KNOBS:
+            raise SystemExit(
+                f"unknown compile knob {key!r} (have: "
+                f"{', '.join(sorted(_COMPILE_KNOBS))})"
+            )
+        value = compile_knobs[key]
+        # same bool-is-int trap as the sched knobs: `"aot": true`
+        # would stringify to "True" and fail run.sh's strict-0/1
+        # LO_AOT preflight on every machine
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise SystemExit(f"compile.{key} must be an integer")
+        if key in ("aot", "publish"):
+            if value not in (0, 1):
+                raise SystemExit(f"compile.{key} must be 0 or 1")
+        elif value < 0:  # max_programs: 0 = enumerate-and-drop-all
+            raise SystemExit("compile.max_programs must be >= 0")
     tsdb = manifest.setdefault("tsdb", {})
     for key in tsdb:
         if key not in _TSDB_KNOBS:
@@ -450,6 +473,17 @@ _RESUME_KNOBS = {
     "every_segments": "LO_RESUME_EVERY_SEGMENTS",
 }
 
+# manifest compile.<knob> -> the env var every machine receives
+# (docs/compile.md). Cluster-wide: the fleet executable cache only
+# pays off when every member enumerates the SAME manifest — a member
+# with a different program cap would publish a different grid and
+# peers would miss on programs they expected to fetch hot.
+_COMPILE_KNOBS = {
+    "aot": "LO_AOT",
+    "max_programs": "LO_AOT_MAX_PROGRAMS",
+    "publish": "LO_AOT_PUBLISH",
+}
+
 # manifest tsdb.<knob> -> the env var every machine receives
 # (docs/observability.md). Cluster-wide: the retention cap and scrape
 # cadence shape ONE shared ring in the head store, and trace_ring
@@ -543,6 +577,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _RESUME_KNOBS.items():
         if knob in manifest.get("resume", {}):
             shared[env_var] = str(manifest["resume"][knob])
+    for knob, env_var in _COMPILE_KNOBS.items():
+        if knob in manifest.get("compile", {}):
+            shared[env_var] = str(manifest["compile"][knob])
     for knob, env_var in _TSDB_KNOBS.items():
         if knob in manifest.get("tsdb", {}):
             shared[env_var] = str(manifest["tsdb"][knob])

@@ -99,6 +99,19 @@
 #                         integral >= 1 — larger N trades re-done work
 #                         after a crash for fewer artifact writes)
 #
+# AOT compile-plane knobs (docs/compile.md has the full table):
+#   LO_AOT                1 = boot-time background precompile of the
+#                         program manifest into the persistent jit
+#                         cache (default 0 — short-lived processes
+#                         never amortize the pass)
+#   LO_AOT_MAX_PROGRAMS   manifest-entry cap for the pass; everything
+#                         past it lands on a LOGGED drop list (default
+#                         64; strictly integral >= 0, 0 = enumerate
+#                         only)
+#   LO_AOT_PUBLISH        1 = publish compiled executables to the
+#                         __lo_executables__ store collection so the
+#                         fleet shares them (default 1)
+#
 # Fleet observability knobs (docs/observability.md has the full table):
 #   LO_TSDB_POINTS        retained samples per metric family x instance
 #                         in the store's __lo_metrics__ ring (default
@@ -165,6 +178,11 @@ lo_profile.validate_env()
 # one-wide
 from learningorchestra_tpu.utils import webloop
 webloop.validate_env()
+# AOT compile-plane knobs: LO_AOT / LO_AOT_PUBLISH strictly 0/1,
+# LO_AOT_MAX_PROGRAMS strictly integral >= 0 — a typo'd LO_AOT must
+# refuse bring-up, never silently boot cold (or silently precompile)
+from learningorchestra_tpu.compile import config as compile_config
+compile_config.validate_env()
 for knob in ("LO_STORE_COMPRESS", "LO_WRITE_OVERLAP", "LO_REPLICATION",
              "LO_STORE_SYNC_REPL", "LO_WIRE_V2", "LO_SHAPE_BUCKETS",
              "LO_EPHEMERAL", "LO_REPLICATE", "LO_STACK_EXIT_ON_STDIN_EOF",

@@ -1,0 +1,71 @@
+"""The AOT compile plane (docs/compile.md).
+
+Four pieces, one goal — a fleet member never pays a compile another
+member (or its own boot) already paid:
+
+- :mod:`~learningorchestra_tpu.compile.manifest` enumerates the finite
+  program universe off the shared shape grid;
+- :mod:`~learningorchestra_tpu.compile.aot` lowers + compiles it at
+  boot (background, off the device queue) into the persistent cache;
+- :mod:`~learningorchestra_tpu.compile.fleetcache` moves serialized
+  executables through the ``__lo_executables__`` store collection;
+- :mod:`~learningorchestra_tpu.compile.warmup` runs the serve path's
+  fixed dispatch shape when a checkpoint publishes.
+
+This module owns the process-global **publish hook**: checkpoint
+writers (ml/builder.py, ml/sweep.py) call :func:`checkpoint_published`
+after their atomic ``os.replace``; a service that can warm the serve
+path (services/model_builder.py) registers the handler. Default is a
+no-op — library callers, tests and scripts publish checkpoints without
+dragging in the serve plane."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from learningorchestra_tpu.compile.aot import (  # noqa: F401
+    AotPlane,
+    backend_fingerprint,
+    boot_compile_plane,
+    compile_spec,
+    deserialize_compiled,
+    serialize_compiled,
+)
+from learningorchestra_tpu.compile.manifest import (  # noqa: F401
+    ProgramSpec,
+    enumerate_programs,
+    specs_for_artifact,
+)
+
+_HANDLER: Optional[Callable[[str, Optional[int]], None]] = None
+_HANDLER_LOCK = threading.Lock()
+
+
+def set_publish_handler(
+    handler: Optional[Callable[[str, Optional[int]], None]],
+):
+    """Install the process-wide checkpoint-publication handler
+    (``handler(path, features)``); returns the previous one. Latest
+    registration wins — registry entries key on absolute checkpoint
+    paths, so any live serve plane can warm any artifact."""
+    global _HANDLER
+    with _HANDLER_LOCK:
+        previous, _HANDLER = _HANDLER, handler
+    return previous
+
+
+def checkpoint_published(
+    path: str, features: Optional[int] = None
+) -> None:
+    """Notify the compile plane that ``path`` just became (or replaced)
+    a published checkpoint. Never raises into the publishing build:
+    warmup is an optimization, a failed hook must not fail the fit."""
+    with _HANDLER_LOCK:
+        handler = _HANDLER
+    if handler is None:
+        return
+    try:
+        handler(path, features)
+    except Exception:  # noqa: BLE001 — publication outlives the hook
+        pass

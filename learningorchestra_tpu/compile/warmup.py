@@ -1,0 +1,71 @@
+"""Publish-time serve warmup: pay the first predict's compile at
+checkpoint publication, not on a user's request.
+
+When the builder (or the sweep's argmax winner) publishes a checkpoint
+(the ``os.replace`` in ml/checkpoint.py), the model_builder service's
+publish handler (registered via
+:func:`learningorchestra_tpu.compile.set_publish_handler`) submits a
+LOW-priority device job running :func:`warm_artifact`: load the model
+through the serve registry (priming its device-resident cache) and
+execute one real forward at the serving path's fixed dispatch shape —
+``grid_size(1, max_batch)`` padded rows, exactly what the MicroBatcher
+dispatches (serve/batcher.py). An AOT ``lower().compile()`` alone
+would warm the persistent cache but NOT the in-process jit call path
+(measured: the next call still re-enters backend compile), so warmup
+executes the real call. Low priority: a warmup must never delay the
+builds and predicts the device queue exists for — it fills idle lanes.
+
+The compile (if any) is attributed to the AOT plane in the flight
+recorder (``compile:aot`` span, ``warmup:...`` manifest key), so boot
+and publish-time compiles never masquerade as request-path stalls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def warm_artifact(
+    path: str,
+    features: Optional[int] = None,
+    serve=None,
+    mesh=None,
+    max_batch: Optional[int] = None,
+) -> bool:
+    """Run the serving path's fixed-shape forward for ``path`` once.
+
+    ``features`` is the training feature width (the builder knows it at
+    publish time; tree checkpoints don't record it). Falls back to the
+    model's own parameter shapes where they encode the width (logistic,
+    naive bayes) and skips — returning False — when the width is
+    unknowable: a wrong-width warmup would compile a program the serve
+    path never dispatches."""
+    from learningorchestra_tpu.utils import jitcache
+    from learningorchestra_tpu.utils.shapegrid import grid_size
+
+    if max_batch is None:
+        from learningorchestra_tpu.serve import config as serve_config
+
+        max_batch = serve_config.max_batch()
+    if serve is not None:
+        model = serve.registry.get(path)
+    else:
+        from learningorchestra_tpu.ml.checkpoint import load_model
+
+        model = load_model(path, mesh)
+    if features is None:
+        params = getattr(model, "params", None)
+        if params is not None and "w" in params:
+            features = int(params["w"].shape[0])
+        elif getattr(model, "theta", None) is not None:
+            features = int(model.theta.shape[1])
+        else:
+            return False
+    rows = np.zeros(
+        (grid_size(1, max_batch), int(features)), np.float32
+    )
+    with jitcache.compile_source("aot", f"warmup:{path.rsplit('/', 1)[-1]}"):
+        model.predict_both(rows)
+    return True

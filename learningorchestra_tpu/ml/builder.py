@@ -308,6 +308,17 @@ def train_one(
                 write_checkpoint(gathered, artifact)
         if write_outputs:
             metadata["model_checkpoint"] = artifact
+            # publish-time serve warmup (compile plane): hand the serve
+            # path the chance to precompile this artifact's fixed
+            # dispatch shape before the first POST /predict asks for
+            # it. Feature width rides along — tree checkpoints don't
+            # record it. No-op unless a service registered a handler;
+            # never raises into the build.
+            from learningorchestra_tpu import compile as lo_compile
+
+            lo_compile.checkpoint_published(
+                artifact, features=int(X_train.shape[1])
+            )
 
     prediction = None
     if features_evaluation is not None:

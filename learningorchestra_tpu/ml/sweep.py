@@ -626,6 +626,12 @@ def run_sweep(
         # atomic publication (temp + os.replace, ml/checkpoint.py): the
         # serving registry's rev stamp sees the winner, never a partial
         save_model(model_from_params(result["params"][best], mesh), checkpoint)
+        # publish-time serve warmup (compile plane): feature width is
+        # derivable from the winner's own params where the model
+        # records it (lr/nb); the handler skips kinds that don't
+        from learningorchestra_tpu import compile as lo_compile
+
+        lo_compile.checkpoint_published(checkpoint)
     points = [
         {**p["grid"], "accuracy": p["accuracy"], "weighted_f1": p["weighted_f1"]}
         for p in result["points"]

@@ -133,6 +133,39 @@ def create_app(
     serve_knobs = serve_config.validate_all()
     serve_timeout_s = serve_knobs["request_timeout_s"]
     serve_max_rows = serve_knobs["max_rows"]
+
+    # Publish-time serve warmup (docs/compile.md): when a build or
+    # sweep publishes a checkpoint, ride a LOW-priority device job
+    # that loads it through the serve registry and executes the fixed
+    # dispatch shape — the first POST /models/<name>/predict then hits
+    # a compiled program. Low priority (the scheduler's heap prefers
+    # larger values) keeps warmups behind every real build/predict;
+    # no store/collection binding, so a warmup never shows up as a
+    # dataset job. Process-wide handler, latest app wins — registry
+    # entries key on absolute paths, so any live plane can warm any
+    # artifact.
+    from learningorchestra_tpu import compile as lo_compile
+
+    def on_checkpoint_published(path: str, features) -> None:
+        def warm() -> None:
+            from learningorchestra_tpu.compile.warmup import warm_artifact
+
+            warm_artifact(path, features=features, serve=serve_plane())
+
+        try:
+            jobs.submit(
+                f"warmup:{os.path.basename(path)}",
+                warm,
+                job_class=DEVICE_CLASS,
+                priority=-5,
+            )
+        except (DuplicateJobError, QueueFullError):
+            # a republish racing its own warmup, or a saturated device
+            # queue: warmup is opportunistic — the publication stands,
+            # the first predict just pays the compile it always did
+            pass
+
+    lo_compile.set_publish_handler(on_checkpoint_published)
     serve_seconds = app.registry.histogram(
         "lo_serve_request_seconds",
         "End-to-end predict latency (admission to response build)",

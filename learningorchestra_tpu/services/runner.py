@@ -466,6 +466,14 @@ def main() -> None:
 
     print(f"web config: {webloop.validate_env()}", flush=True)
 
+    # ...and the AOT compile-plane knobs (docs/compile.md): whether the
+    # boot precompile pass runs, how much of the manifest it covers,
+    # and whether executables publish to the fleet — a typo'd LO_AOT
+    # must refuse bring-up, never silently boot cold
+    from learningorchestra_tpu.compile import config as compile_config
+
+    print(f"compile config: {compile_config.validate_env()}", flush=True)
+
     data_dir = _str_env("LO_DATA_DIR", os.path.join(os.getcwd(), "lo_data"))
     from learningorchestra_tpu.utils.jitcache import enable_compile_cache
 
@@ -556,6 +564,18 @@ def main() -> None:
     maybe_start_collector(
         store, instance=service or "runner", service=service or "runner"
     )
+
+    # The AOT compile plane (docs/compile.md): fleet-fetch serialized
+    # executables into the local jit cache, precompile the manifest in
+    # the background (a daemon thread — compilation is host CPU work,
+    # it never occupies a device-class scheduler slot), publish fresh
+    # entries back. Gated on LO_AOT; the kill -9 restart drill rides
+    # this — a restarted runner pulls its own previously published
+    # programs and replays with zero compile misses.
+    from learningorchestra_tpu.compile import boot_compile_plane
+
+    if boot_compile_plane(store=store, models_dir=models_dir or ""):
+        print("aot compile plane: precompiling in background", flush=True)
 
     jobs = make_job_manager(store, scope=service or "all")
     recovered = recover_jobs(store, jobs)

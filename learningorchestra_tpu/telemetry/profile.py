@@ -119,7 +119,7 @@ def _flow_metrics() -> dict:
                 "compile_events": registry.counter(
                     "lo_compile_events_total",
                     "XLA persistent-cache outcomes observed",
-                    labels=("result",),
+                    labels=("result", "source"),
                 ),
                 "compile_seconds": registry.counter(
                     "lo_compile_seconds_total",
@@ -198,13 +198,19 @@ def flow_totals() -> dict:
 
 
 def account_compile(
-    result: Optional[str] = None, seconds: Optional[float] = None
+    result: Optional[str] = None,
+    seconds: Optional[float] = None,
+    source: str = "jit",
 ) -> None:
     """A persistent-cache event (``result`` = hit|miss) and/or compile
-    seconds — utils/jitcache.py's jax.monitoring listeners feed this."""
+    seconds — utils/jitcache.py's jax.monitoring listeners feed this.
+    ``source`` says which lane triggered the compile: ``jit`` (request
+    path), ``aot`` (the boot precompile pass) or ``fleetcache`` (the
+    warm pass replaying fleet-fetched artifacts), so a dashboard can
+    tell boot-time compile spend from user-facing compile stalls."""
     metrics = _flow_metrics()
     if result is not None:
-        metrics["compile_events"].labels(result).inc()
+        metrics["compile_events"].labels(result, source).inc()
     if seconds is not None:
         metrics["compile_seconds"].inc(seconds)
 

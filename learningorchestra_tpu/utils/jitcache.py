@@ -14,9 +14,35 @@ sharing the directory between processes and across restarts is safe.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 
 _ACTIVE_DIR: str | None = None
+
+# Ambient compile source for the jax.monitoring listeners below:
+# "jit" = a request-path trace compiled on demand, "aot" = the boot
+# precompile pass (compile/aot.py), "fleetcache" = the warm pass
+# replaying programs satisfied from fleet-fetched artifacts. A
+# contextvar, not a global: the AOT pass runs on its own background
+# thread while request threads keep compiling with source="jit".
+_COMPILE_SOURCE: contextvars.ContextVar[tuple[str, str | None]] = (
+    contextvars.ContextVar("lo_compile_source", default=("jit", None))
+)
+
+
+@contextlib.contextmanager
+def compile_source(source: str, key: str | None = None):
+    """Attribute every compile jax.monitoring reports inside the block
+    to ``source`` (and optionally a manifest ``key``) — the PR 8
+    listener otherwise books boot compiles onto whatever job happens
+    to be ambient, which made AOT warmup indistinguishable from a
+    request-path compile storm in the flight recorder."""
+    token = _COMPILE_SOURCE.set((source, key))
+    try:
+        yield
+    finally:
+        _COMPILE_SOURCE.reset(token)
 
 # Live counters behind cache_stats() — registered once with
 # jax.monitoring so "the cache didn't help" is a measured fact
@@ -55,14 +81,27 @@ def _account_compile(result=None, seconds=None, span_name=None) -> None:
     become ``lo_compile_*`` counters and — when a trace is active on
     the compiling thread, which it is for every scheduled job — an
     already-finished span on the job timeline, so a compile-bound
-    build shows WHERE the compiler ate its wall-clock. Listener
-    context: must never raise into jax.monitoring."""
+    build shows WHERE the compiler ate its wall-clock. AOT/warmup
+    compiles get their OWN span name + manifest-key attribute (the
+    ambient :func:`compile_source`), so the recorder separates boot
+    compiles from request-path compiles instead of booking both onto
+    whatever job is ambient. Listener context: must never raise into
+    jax.monitoring."""
     try:
         from learningorchestra_tpu.telemetry import profile, tracing
 
-        profile.account_compile(result=result, seconds=seconds)
+        source, manifest_key = _COMPILE_SOURCE.get()
+        profile.account_compile(
+            result=result, seconds=seconds, source=source
+        )
         if span_name is not None and seconds is not None:
-            tracing.record_span(span_name, seconds, compile=True)
+            if source != "jit":
+                meta = {"compile": True, "source": source}
+                if manifest_key is not None:
+                    meta["manifest_key"] = manifest_key
+                tracing.record_span("compile:aot", seconds, **meta)
+            else:
+                tracing.record_span(span_name, seconds, compile=True)
         elif result is not None:
             # typed hit/miss counts on the enclosing span (fit, build…)
             tracing.add_attr(f"compile_{result}", 1)
@@ -129,6 +168,15 @@ def enable_compile_cache(default_dir: str | None = None) -> str | None:
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # The default ("xla_gpu_per_fusion_autotune_cache_dir") writes an
+    # ABSOLUTE path under cache_dir into debug_options, and the cache
+    # key hashes debug_options without clearing that field — so every
+    # cache key silently binds to this machine's cache-dir path, and an
+    # executable published through the fleet cache (compile/fleetcache)
+    # could never hit on a runner with a different data dir. The knob
+    # only feeds GPU autotune/kernel caches, irrelevant here; off it
+    # goes, and keys depend on program + versions + backend alone.
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "")
     # default min compile time (1 s) skips trivial programs; keep it
     _ACTIVE_DIR = cache_dir
     return cache_dir

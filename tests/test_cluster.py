@@ -780,6 +780,62 @@ class TestResumeManifest:
             load({"every_segments": True})
 
 
+class TestCompileManifest:
+    def test_compile_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["compile"] = {"aot": 1, "max_programs": 128, "publish": 0}
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # every member enumerates the SAME grid
+            env = plan["env"]
+            assert env["LO_AOT"] == "1"
+            assert env["LO_AOT_MAX_PROGRAMS"] == "128"
+            assert env["LO_AOT_PUBLISH"] == "0"
+
+    def test_compile_section_absent_sets_nothing(self, tmp_path):
+        cluster = _load_cluster_module()
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(_manifest()))
+        for plan in cluster.machine_plans(cluster.load_manifest(str(path))):
+            assert "LO_AOT" not in plan["env"]
+            assert "LO_AOT_MAX_PROGRAMS" not in plan["env"]
+            assert "LO_AOT_PUBLISH" not in plan["env"]
+
+    def test_compile_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(compile_knobs):
+            manifest = _manifest()
+            manifest["compile"] = compile_knobs
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # max_programs 0 = enumerate-and-drop-all: valid (drops logged)
+        loaded = load({"aot": 0, "max_programs": 0, "publish": 1})
+        assert loaded["compile"]["max_programs"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"aot": 2})
+        with pytest.raises(SystemExit):
+            # bool-is-int trap: str(True) is "True", which the runner's
+            # strict 0/1 preflight would then refuse on every machine
+            load({"aot": True})
+        with pytest.raises(SystemExit):
+            load({"publish": True})
+        with pytest.raises(SystemExit):
+            load({"aot": "1"})
+        with pytest.raises(SystemExit):
+            load({"max_programs": -1})
+        with pytest.raises(SystemExit):
+            load({"max_programs": 64.0})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"max_programs": True})
+
+
 class TestMetricsScrape:
     def test_parse_prometheus_sums_families(self):
         cluster = _load_cluster_module()
