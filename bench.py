@@ -615,6 +615,158 @@ def bench_wire() -> dict:
         server.stop()
 
 
+def bench_shard() -> dict:
+    """Horizontal-sharding section: the SAME ingest, warm scatter-gather
+    read, and warm single-classifier build driven through ``connect()``
+    at 1, 2, and 4 store groups — each group its own subprocess, its own
+    GIL, so aggregate MB/s can actually scale (docs/dataplane.md). The
+    headline is ``x4_ingest_scaling_ratio`` (near-linear is the claim);
+    warm read rows/s and warm nb-build rows/s ride along so the fan-out
+    client's merge overhead can never regress unnoticed. One group is
+    the degenerate plain ``RemoteStore`` — the unsharded baseline every
+    ratio divides by.
+
+    The scaling ratio's ceiling is ``min(groups, cpu_cores)``: each
+    group is one Python server saturating one core, so a 1-core CI box
+    honestly reads ~1.0 where a real multi-core host reads near-linear
+    — ``cpu_cores`` rides in the output so --compare diffs across
+    machines stay interpretable."""
+    import re
+    import subprocess
+    import sys
+
+    from learningorchestra_tpu.core.columns import Column
+    from learningorchestra_tpu.core.store_service import connect
+    from learningorchestra_tpu.ml.builder import build_model
+
+    rows = int(os.environ.get("LO_BENCH_SHARD_ROWS", "400000"))
+    rng = np.random.default_rng(17)
+    features = {
+        f"f{i}": Column.from_numpy(rng.random(rows)) for i in range(8)
+    }
+    labels = Column.from_numpy((rng.random(rows) > 0.5).astype(np.int64))
+    payload_mb = rows * 8 * 8 / 1e6  # the float feature payload alone
+
+    def start_group():
+        env = dict(os.environ)
+        env["LO_STORE_PORT"] = "0"
+        env["PYTHONUNBUFFERED"] = "1"
+        # each group in-memory in its own process: the section measures
+        # the wire + insert path and real multi-GIL scaling, not N WALs
+        # contending for one bench disk
+        for stale in ("LO_DATA_DIR", "LO_REPLICATE", "LO_PEERS",
+                      "LO_ARBITERS", "LO_PRIMARY_URL", "LO_NODE_ID"):
+            env.pop(stale, None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "learningorchestra_tpu.core.store_service"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            match = re.search(r"store server on [^:]+:(\d+)", line)
+            if match:
+                return proc, f"http://127.0.0.1:{match.group(1)}"
+        proc.kill()
+        raise RuntimeError("shard group store did not come up")
+
+    preprocessor = (
+        "from pyspark.ml.feature import VectorAssembler\n"
+        "feature_cols = [c for c in training_df.schema.names if c != 'label']\n"
+        "assembler = VectorAssembler(inputCols=feature_cols, outputCol='features')\n"
+        "features_training = assembler.transform(training_df)\n"
+        "features_testing = assembler.transform(testing_df)\n"
+        "features_evaluation = assembler.transform(testing_df)\n"
+    )
+
+    out: dict = {
+        "rows": rows,
+        "payload_mb": round(payload_mb, 1),
+        "cpu_cores": os.cpu_count(),
+    }
+    baseline: Optional[dict] = None
+    for shards in (1, 2, 4):
+        procs: list = []
+        store = None
+        try:
+            urls = []
+            for _ in range(shards):
+                proc, url = start_group()
+                procs.append(proc)
+                urls.append(url)
+            store = connect(";".join(urls))
+            for name in ("bench_shard_train", "bench_shard_test"):
+                store.create_collection(name)
+                store.insert_one(
+                    name,
+                    {
+                        "_id": 0,
+                        "filename": name,
+                        "finished": True,
+                        "fields": [f"f{i}" for i in range(8)] + ["label"],
+                    },
+                )
+            start = time.perf_counter()
+            store.insert_column_arrays(
+                "bench_shard_train", dict(features, label=labels), start_id=1
+            )
+            ingest_s = time.perf_counter() - start
+            # the tiny test split rides outside the timed window
+            store.insert_column_arrays(
+                "bench_shard_test",
+                {name: values.slice(0, 2048) for name, values in features.items()}
+                | {"label": labels.slice(0, 2048)},
+                start_id=1,
+            )
+            read = lambda: store.read_column_arrays("bench_shard_train")  # noqa: E731
+            read()  # warm connections + the shard map
+            warm_read_s = _best_of(read, repeats=2)
+            build = lambda: build_model(  # noqa: E731
+                store,
+                "bench_shard_train",
+                "bench_shard_test",
+                preprocessor,
+                ["nb"],
+                write_outputs=False,
+            )
+            build()  # cold: XLA compile + devcache fill
+            warm_build_s = _best_of(build, repeats=1)
+            entry = {
+                "ingest_s": round(ingest_s, 4),
+                "ingest_mb_per_s": round(payload_mb / ingest_s, 1),
+                "warm_read_rows_per_sec": round(rows / warm_read_s, 1),
+                "warm_build_rows_per_sec": round(rows / warm_build_s, 1),
+            }
+            out[f"shards{shards}"] = entry
+            if baseline is None:
+                baseline = entry
+            else:
+                out[f"x{shards}_ingest_scaling_ratio"] = round(
+                    entry["ingest_mb_per_s"] / baseline["ingest_mb_per_s"], 2
+                )
+                out[f"x{shards}_warm_build_ratio"] = round(
+                    entry["warm_build_rows_per_sec"]
+                    / baseline["warm_build_rows_per_sec"],
+                    2,
+                )
+        finally:
+            if store is not None:
+                store.close()
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+    return out
+
+
 def bench_serve() -> dict:
     """Serve section: closed-loop load against the online predict lane
     (docs/serving.md) at 1 / 8 / 64 concurrent clients — p50/p99
@@ -1808,6 +1960,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
             4,
         )
     section("wire", bench_wire)  # transport head-to-head (v1/v2/shm)
+    section("shard", bench_shard)  # scatter-gather scaling at 1/2/4 groups
     section("serve", bench_serve)  # the online predict lane's latency
     section("waiters", bench_waiters)  # push job completion (docs/web.md)
     section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch

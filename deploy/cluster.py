@@ -93,6 +93,12 @@ Manifest (JSON)::
         "auto_promote_s": 5,       #   machine's LO_STORE_URL names both
         "sync_repl": 0             #   stores. sync_repl=1 withholds acks
       },                           #   until a follower holds the write
+      "sharding": {                # optional horizontal store sharding
+        "shards": 4,               #   LO_SHARDS: store groups on the
+        "stripe_rows": 8192,       #   head (port stride 10; composes
+        "map_ttl_s": 5             #   with replication per group) /
+      },                           #   LO_SHARD_STRIPE_ROWS /
+                                   #   LO_SHARDMAP_TTL_S (docs/dataplane.md)
       "restart_delay": 5,
       "max_cluster_restarts": null # null = retry forever
     }
@@ -392,6 +398,48 @@ def load_manifest(path: str) -> dict:
         sync = replication.setdefault("sync_repl", 0)
         if isinstance(sync, bool) or sync not in (0, 1):
             raise SystemExit("replication.sync_repl must be 0 or 1")
+    sharding = manifest.setdefault("sharding", {})
+    for key in sharding:
+        if key not in _SHARDING_KNOBS:
+            raise SystemExit(
+                f"unknown sharding knob {key!r} (have: "
+                f"{', '.join(sorted(_SHARDING_KNOBS))})"
+            )
+        value = sharding[key]
+        if key == "map_ttl_s":
+            if (
+                isinstance(value, bool)
+                or not isinstance(value, (int, float))
+                or value < 0
+            ):
+                raise SystemExit(
+                    "sharding.map_ttl_s must be >= 0 (0 = revalidate "
+                    "the shard map on every read)"
+                )
+        # bool-is-int trap, same as the sched knobs: `"shards": true`
+        # would stringify to "True" and fail every preflight downstream
+        elif (
+            isinstance(value, bool)
+            or not isinstance(value, int)
+            or value < 1
+        ):
+            raise SystemExit(f"sharding.{key} must be an integer >= 1")
+    shards = sharding.get("shards", 1)
+    if shards > 1 and _replication_enabled(manifest):
+        # each extra group claims store_port + 10*i (+1 follower,
+        # +2 arbiter): the meta group's configured pair must not land
+        # inside any group's stride window
+        group_ports = set()
+        for index in range(1, shards):
+            base = manifest["store_port"] + 10 * index
+            group_ports.update((base, base + 1, base + 2))
+        replication = manifest["replication"]
+        for key in ("follower_port", "arbiter_port"):
+            if replication[key] in group_ports:
+                raise SystemExit(
+                    f"replication.{key} collides with a shard group "
+                    "port (groups claim store_port + 10*i .. +2)"
+                )
     return manifest
 
 
@@ -507,6 +555,17 @@ _SLO_KNOBS = {
     "replication_lag": "LO_SLO_REPL_LAG",
 }
 
+# manifest sharding.<knob> -> the env var every machine receives
+# (docs/dataplane.md). Cluster-wide NON-NEGOTIABLY: shards and
+# stripe_rows define the hash-ring placement every client computes
+# locally, so a per-host skew would route the same _id to different
+# groups; the shard-map doc pins them and clients refuse a mismatch.
+_SHARDING_KNOBS = {
+    "shards": "LO_SHARDS",
+    "stripe_rows": "LO_SHARD_STRIPE_ROWS",
+    "map_ttl_s": "LO_SHARDMAP_TTL_S",
+}
+
 # manifest replication.<knob> (docs/replication.md); the head machine
 # runs the whole store plane, every machine's LO_STORE_URL names the
 # primary AND the follower for client-side failover
@@ -543,6 +602,19 @@ def machine_plans(manifest: dict) -> list[dict]:
         store_url += (
             f",http://{head['host']}:{replication['follower_port']}"
         )
+    shards = manifest.get("sharding", {}).get("shards", 1)
+    if shards > 1:
+        # one `;`-separated segment per store group (core/shardmap.py):
+        # group i lives at store_port + 10*i, its follower one above —
+        # the exact ports stack.py's sharded store plane binds
+        groups = [store_url]
+        for index in range(1, shards):
+            base = manifest["store_port"] + 10 * index
+            group = f"http://{head['host']}:{base}"
+            if _replication_enabled(manifest):
+                group += f",http://{head['host']}:{base + 1}"
+            groups.append(group)
+        store_url = ";".join(groups)
     coordinator = f"{head['host']}:{manifest['coord_port']}"
     shared = dict(manifest["env"])
     shared["LO_TOTAL_PROCESSES"] = str(total)
@@ -586,6 +658,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _SLO_KNOBS.items():
         if knob in manifest.get("slo", {}):
             shared[env_var] = str(manifest["slo"][knob])
+    for knob, env_var in _SHARDING_KNOBS.items():
+        if knob in manifest.get("sharding", {}):
+            shared[env_var] = str(manifest["sharding"][knob])
     # the driver scrapes every member centrally (up()'s scrape loop)
     # and pushes into the head store's TSDB ring, so the per-process
     # fallback collectors stay off; an explicit manifest env wins

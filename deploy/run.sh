@@ -89,6 +89,17 @@
 #   LO_STORE_SYNC_REPL    1 = acks wait for a follower (zero lost
 #                         acknowledged writes; LO_STORE_ACK_TIMEOUT_S)
 #
+# Horizontal sharding knobs (docs/dataplane.md has the full table):
+#   LO_SHARDS             store groups stack.py launches (default 1 =
+#                         unsharded, byte-identical wire traffic; N > 1
+#                         strides ports by 10 per extra group and
+#                         composes with LO_REPLICATION per group)
+#   LO_SHARD_STRIPE_ROWS  rows per consistent-hash stripe (default 8192;
+#                         strictly integral >= 1 — part of the shard-map
+#                         placement contract, identical on every host)
+#   LO_SHARDMAP_TTL_S     shard-map client cache TTL in seconds
+#                         (default 5; 0 = revalidate rev on every read)
+#
 # Crash-resume knobs (docs/robustness.md has the full table):
 #   LO_RESUME             1 = segment-checkpointed fits + resume-aware
 #                         recovery (default 1; 0 = orphaned RUNNING
@@ -239,7 +250,8 @@ for knob, floor in (("LO_WIRE_ROWS", 1), ("LO_WIRE_ROWS_BIN", 1),
                     ("LO_CHUNK_RETRIES", 0), ("LO_READ_RETRIES", 0),
                     ("LO_WORKERS", 0), ("LO_TOTAL_PROCESSES", 0),
                     ("LO_PROCESS_BASE", 0), ("LO_MAX_RESTARTS", 0),
-                    ("LO_TRACE_RING", 1), ("LO_TSDB_POINTS", 1)):
+                    ("LO_TRACE_RING", 1), ("LO_TSDB_POINTS", 1),
+                    ("LO_SHARDS", 1)):
     value = os.environ.get(knob, "").strip()
     if value:
         try:
@@ -269,6 +281,11 @@ if value:
     if scale <= 0:
         raise SystemExit(
             f"LO_PROGRAM_ROW_STEPS must be a scale > 0, got {value!r}")
+# sharding knobs: stripe rows strictly integral >= 1, shard-map TTL a
+# float >= 0 — a typo'd LO_SHARD_STRIPE_ROWS must refuse bring-up, or
+# every client would compute a different hash-ring placement
+from learningorchestra_tpu.core import shardmap
+shardmap.validate_env()
 # crash-resume knobs: LO_RESUME strictly 0/1, checkpoint cadence a
 # strict integer >= 1 — "0.5" silently becoming "never checkpoint"
 # would void the whole crash-resume contract at the worst moment

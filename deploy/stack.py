@@ -214,10 +214,13 @@ def wait_health(url: str, timeout: float) -> None:
 
 def _start_store_plane(children, store, host, log) -> str:
     """Start the store child — plus the follower and arbiter when the
-    replicated plane is configured (LO_REPLICATION=1) — and return the
-    ``LO_STORE_URL`` services should use: a comma list naming the
-    primary AND the follower, so RemoteStore fails over client-side
-    when a takeover happens (core/store_service.py)."""
+    replicated plane is configured (LO_REPLICATION=1), plus every
+    additional shard group when LO_SHARDS>1 — and return the
+    ``LO_STORE_URL`` services should use: per group a comma list naming
+    the primary AND the follower (client-side failover,
+    core/store_service.py), groups joined by ``;`` (the sharded
+    scatter-gather client, core/shardstore.py). Group 0 (the plain
+    ``store`` child) is the meta group."""
     store.start()
     store_live_port = store.wait_port(60)
     store_url = f"http://{host}:{store_live_port}"
@@ -234,7 +237,31 @@ def _start_store_plane(children, store, host, log) -> str:
             urls.append(f"http://{host}:{child_port}")
     if len(urls) > 1:
         log(f"[stack] replicated store plane up: {','.join(urls)} + arbiter")
-    return ",".join(urls)
+    group_urls = [",".join(urls)]
+    index = 1
+    while f"store-s{index}" in children:
+        primary = children[f"store-s{index}"]
+        primary.start()
+        primary_port = primary.wait_port(60)
+        primary_url = f"http://{host}:{primary_port}"
+        wait_health(primary_url, 60)
+        shard_urls = [primary_url]
+        for suffix in ("follower", "arbiter"):
+            child = children.get(f"store-s{index}-{suffix}")
+            if child is None:
+                continue
+            child.start()
+            child_port = child.wait_port(60)
+            if suffix == "follower":
+                shard_urls.append(f"http://{host}:{child_port}")
+        group_urls.append(",".join(shard_urls))
+        index += 1
+    if len(group_urls) > 1:
+        log(
+            f"[stack] sharded store plane up: {len(group_urls)} groups "
+            f"({';'.join(group_urls)})"
+        )
+    return ";".join(group_urls)
 
 
 def main() -> int:
@@ -340,6 +367,84 @@ def main() -> int:
             arbiter_env,
             log,
         )
+
+    # Horizontal sharding (docs/dataplane.md): LO_SHARDS=N launches N-1
+    # EXTRA store groups beyond the meta group above, each on a port
+    # stride of 10 from LO_STORE_PORT (primary base+10i, its follower
+    # +1, its arbiter +2 when LO_REPLICATION=1) with its own data dir —
+    # N WALs is the whole point. run.sh preflights the knob; this parse
+    # re-checks because cluster.py launches stack.py directly.
+    shards_raw = os.environ.get("LO_SHARDS", "").strip() or "1"
+    try:
+        shards = int(shards_raw)
+        if shards < 1:
+            raise ValueError(shards_raw)
+    except ValueError:
+        log(f"[stack] LO_SHARDS must be an integer >= 1, got {shards_raw!r}")
+        return 2
+    if shards > 1 and process_base_early == 0:
+        if store_port == "0":
+            log("[stack] LO_SHARDS>1 needs a fixed LO_STORE_PORT")
+            return 2
+        shard_base_port = int(store_port)
+        for index in range(1, shards):
+            group_port = shard_base_port + 10 * index
+            group_name = f"store-s{index}"
+            group_dir = os.path.join(data_dir, f"shard{index}")
+            group_env = dict(base_env)
+            group_env["LO_STORE_PORT"] = str(group_port)
+            group_env["LO_DATA_DIR"] = group_dir
+            if replication:
+                group_primary = f"http://{host}:{group_port}"
+                group_follower = f"http://{host}:{group_port + 1}"
+                group_arbiter = f"http://{host}:{group_port + 2}"
+                group_env.update(
+                    {
+                        "LO_REPLICATE": "1",
+                        "LO_PEERS": group_follower,
+                        "LO_ARBITERS": group_arbiter,
+                        "LO_NODE_ID": f"{group_name}-primary",
+                    }
+                )
+                follower_env = dict(base_env)
+                follower_env.update(
+                    {
+                        "LO_STORE_PORT": str(group_port + 1),
+                        # its own WAL dir — two stores never share a log
+                        "LO_DATA_DIR": os.path.join(group_dir, "follower"),
+                        "LO_PRIMARY_URL": group_primary,
+                        "LO_PEERS": group_primary,
+                        "LO_ARBITERS": group_arbiter,
+                        "LO_AUTO_PROMOTE_S": os.environ.get(
+                            "LO_AUTO_PROMOTE_S", "5"
+                        ),
+                        "LO_NODE_ID": f"{group_name}-follower",
+                    }
+                )
+                arbiter_env = dict(base_env)
+                arbiter_env["LO_ARBITER_PORT"] = str(group_port + 2)
+                children[f"{group_name}-follower"] = Child(
+                    f"{group_name}-follower",
+                    [
+                        sys.executable,
+                        "-m",
+                        "learningorchestra_tpu.core.store_service",
+                    ],
+                    follower_env,
+                    log,
+                )
+                children[f"{group_name}-arbiter"] = Child(
+                    f"{group_name}-arbiter",
+                    [sys.executable, "-m", "learningorchestra_tpu.core.arbiter"],
+                    arbiter_env,
+                    log,
+                )
+            children[group_name] = Child(
+                group_name,
+                [sys.executable, "-m", "learningorchestra_tpu.core.store_service"],
+                group_env,
+                log,
+            )
 
     def write_ports() -> None:
         ports = {
@@ -451,7 +556,9 @@ def _supervise(
     log,
 ) -> int:
     service_store_url = _start_store_plane(children, store, host, log)
-    store_url = service_store_url.split(",")[0]
+    # the META group's primary (first ';' group, first ',' replica) —
+    # the url the store-restart re-point logic below tracks
+    store_url = service_store_url.split(";")[0].split(",")[0]
 
     for name in SERVICE_NAMES:
         env = dict(base_env)
@@ -528,7 +635,9 @@ def _supervise(
                 try:
                     child.wait_port(120)
                 except TimeoutError as error:
-                    if name in ("store-follower", "store-arbiter"):
+                    if name.startswith("store-") and name.endswith(
+                        ("-follower", "-arbiter")
+                    ):
                         # a redundancy component that cannot come back
                         # (port held by a lingering socket, crash loop)
                         # must not take down the healthy primary and
@@ -644,7 +753,7 @@ def _supervise_multihost(
     see deploy/README.md.
     """
     service_store_url = _start_store_plane(children, store, host, log)
-    store_url = service_store_url.split(",")[0]
+    store_url = service_store_url.split(";")[0].split(",")[0]
 
     coord_port = os.environ.get("LO_COORD_PORT", "12355")
     num_processes = int(

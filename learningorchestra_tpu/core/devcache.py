@@ -119,7 +119,12 @@ def store_token(store) -> str:
                     store._lo_devcache_token = token
                 except AttributeError:  # __slots__ backend: no cache
                     return ""
-    return token
+    # shard topology dimension: a ShardedStore's rev is a SUM over
+    # groups, so a re-wired topology (different shard count or stripe)
+    # could reproduce an old sum over different bytes — scoping the
+    # token by the shard signature invalidates every cached entry on
+    # any topology change instead
+    return token + getattr(store, "shard_signature", "")
 
 
 def mesh_signature(mesh) -> tuple:

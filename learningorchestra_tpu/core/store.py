@@ -405,6 +405,14 @@ class DocumentStore:
         staleness."""
         return -1
 
+    def collection_block_rows(self, collection: str) -> int:
+        """Rows in the collection's columnar block (excluding overlay
+        documents), -1 when the collection is missing. The sharded
+        client (core/shardstore.py) sums these across groups to place
+        appends and split positional reads; row-oriented backends that
+        cannot tell block from overlay report -1 too."""
+        return -1
+
     # --- dataset metadata contract -------------------------------------------
     def metadata(self, collection: str) -> Optional[dict]:
         return self.find_one(collection, {ROW_ID: METADATA_ID})
@@ -1509,6 +1517,11 @@ class InMemoryStore(DocumentStore):
         with self._lock:
             col = self._collections.get(collection)
             return -1 if col is None else col.rev
+
+    def collection_block_rows(self, collection: str) -> int:
+        with self._lock:
+            col = self._collections.get(collection)
+            return -1 if col is None else col.block_rows
 
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
         # Columnar fast path: the histogram's value-count $group runs

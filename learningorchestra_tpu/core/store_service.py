@@ -332,6 +332,15 @@ def create_store_app(
             "columns_wire": "bin2",
             "shm": shm_enabled,
         }
+        stats = getattr(store, "telemetry_stats", None)
+        if stats is not None:
+            # occupancy surface for the sharded fleet: the client-side
+            # shard gauges (telemetry/metrics.py register_sharded_store)
+            # read each group's collection/WAL/spill occupancy here
+            try:
+                payload["occupancy"] = stats()
+            except Exception:  # noqa: BLE001 — health must still answer
+                pass
         poller = role.get("poller")
         if poller is not None:
             payload["replication"] = {
@@ -563,8 +572,14 @@ def create_store_app(
         """The collection's mutation counter — what remote device caches
         probe to validate an entry (core/devcache.py). Same counter the
         binary read frames carry per chunk. Every DocumentStore has the
-        method (the base class answers -1 = unknown)."""
-        return {"rev": store.collection_rev(name)}, 200
+        method (the base class answers -1 = unknown). ``block_rows``
+        rides along (same base-class contract) so the sharded client
+        (core/shardstore.py) places appends and splits positional reads
+        with the one probe it already makes."""
+        return {
+            "rev": store.collection_rev(name),
+            "block_rows": store.collection_block_rows(name),
+        }, 200
 
     @app.route("/c/<name>/read_columns_bin", methods=("POST",))
     @guarded
@@ -1759,6 +1774,21 @@ class RemoteStore(DocumentStore):
     def collection_rev(self, collection: str) -> int:
         return self._get(f"/c/{collection}/rev")["rev"]
 
+    def collection_block_rows(self, collection: str) -> int:
+        # older servers don't ship the field: -1 = unknown, same as the
+        # base-class contract
+        return self._get(f"/c/{collection}/rev").get("block_rows", -1)
+
+    def occupancy_stats(self) -> dict:
+        """The server's collection/WAL/spill occupancy (/health's
+        ``occupancy`` block, absent on older servers) — the per-group
+        probe behind the ``lo_store_shard_*`` gauges. Deliberately NOT
+        named ``telemetry_stats``: register_store keys off that name,
+        and a remote store must not be mistaken for a local one."""
+        health = self._get("/health")
+        occupancy = health.get("occupancy")
+        return occupancy if isinstance(occupancy, dict) else {}
+
     def aggregate(self, collection: str, pipeline: list[dict]) -> list[dict]:
         return self._post(f"/c/{collection}/aggregate", {"pipeline": pipeline})[
             "results"
@@ -1773,11 +1803,24 @@ def connect(url: Optional[str] = None) -> DocumentStore:
     URL is configured (``LO_STORE_URL`` — the analogue of the reference's
     ``DATABASE_URL``; a comma-separated list names the replica pair and
     enables client-side failover), else a process-local WAL-backed
-    store."""
+    store.
+
+    ``;`` separates SHARD GROUPS (``primary,follower;primary,follower``
+    — each group keeps its own comma replica list and failover): two or
+    more groups build a scatter-gather
+    :class:`~learningorchestra_tpu.core.shardstore.ShardedStore` whose
+    first group is the meta group. One group — the default — stays a
+    plain ``RemoteStore``, so the unsharded wire path is untouched by
+    construction, not by configuration."""
     # lo: allow[LO301] free-form URL knob, no domain to preflight
     url = url if url is not None else _str_env("LO_STORE_URL")
     if url:
-        return RemoteStore(url)
+        group_urls = [part.strip() for part in url.split(";") if part.strip()]
+        if len(group_urls) > 1:
+            from learningorchestra_tpu.core.shardstore import ShardedStore
+
+            return ShardedStore([RemoteStore(part) for part in group_urls])
+        return RemoteStore(group_urls[0] if group_urls else url)
     data_dir = _str_env("LO_DATA_DIR")
     return InMemoryStore(data_dir=data_dir)
 

@@ -48,7 +48,11 @@ from learningorchestra_tpu.sched.cancel import (
     current_token,
 )
 from learningorchestra_tpu.sched.coalesce import Coalescer, global_coalescer
-from learningorchestra_tpu.sched.journal import JOURNAL_COLLECTION, JobJournal
+from learningorchestra_tpu.sched.journal import (
+    JOURNAL_COLLECTION,
+    JobJournal,
+    shard_scope,
+)
 from learningorchestra_tpu.sched.policy import (
     TransientJobError,
     backoff_delay,
@@ -82,4 +86,5 @@ __all__ = [
     "global_coalescer",
     "is_transient",
     "recover_jobs",
+    "shard_scope",
 ]

@@ -40,6 +40,20 @@ TERMINAL_EVENTS = frozenset(
 )
 
 
+def shard_scope(scope: str, store) -> str:
+    """The journal scope suffixed with the store's shard topology
+    (``all`` → ``all#sh4x8192`` on a four-group fleet) so recovery
+    replays stay shard-local in two senses: the journal lives whole on
+    the META group (``insert_one`` routes there — no cross-group fold),
+    and a RE-SHARDED fleet sees its old entries as foreign scopes
+    instead of replaying job lineage whose block ids meant a different
+    placement. Resharding in place is a declared non-goal
+    (docs/dataplane.md): drain, then re-ingest. Unsharded stores carry
+    no signature and keep their scopes byte-identical."""
+    signature = getattr(store, "shard_signature", "")
+    return f"{scope}#{signature}" if signature else scope
+
+
 class JobHistory:
     """One job's folded journal: its submit document, the last event
     seen, and any ``progress`` events the run appended — all recovery

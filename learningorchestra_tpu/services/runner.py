@@ -99,7 +99,12 @@ from typing import Optional
 
 from learningorchestra_tpu.core.jobs import JobManager
 from learningorchestra_tpu.core.store import DocumentStore, InMemoryStore
-from learningorchestra_tpu.sched import JobJournal, Scheduler, recover_jobs
+from learningorchestra_tpu.sched import (
+    JobJournal,
+    Scheduler,
+    recover_jobs,
+    shard_scope,
+)
 from learningorchestra_tpu.services import (
     DATA_TYPE_HANDLER_PORT,
     DATABASE_API_PORT,
@@ -224,7 +229,12 @@ def make_job_manager(store: DocumentStore, scope: str = "all") -> JobManager:
     and embeddings against each other process-wide, and every submit is
     journaled in the shared store for crash recovery."""
     return JobManager(
-        scheduler=Scheduler(journal=JobJournal(store, scope=scope))
+        # the scope gains the store's shard-topology suffix so recovery
+        # replays stay shard-local (sched/journal.py shard_scope);
+        # unsharded stores keep their scope strings byte-identical
+        scheduler=Scheduler(
+            journal=JobJournal(store, scope=shard_scope(scope, store))
+        )
     )
 
 
@@ -455,6 +465,23 @@ def main() -> None:
         f"wire config: shm_bytes={shmring.shm_bytes()} "
         f"dtype_policy={dtype_policy()} "
         f"v2={_flag_env('LO_WIRE_V2', default=True)}",
+        flush=True,
+    )
+
+    # ...and the sharding knobs (docs/dataplane.md): an operator should
+    # see at boot how many shard groups this process routes across (the
+    # ';' groups of LO_STORE_URL — 1 means the unsharded wire path) and
+    # which stripe geometry a first write would seed; a typo'd
+    # LO_SHARD_STRIPE_ROWS must refuse bring-up, never silently seed an
+    # unintended placement into the fleet's shard map
+    from learningorchestra_tpu.core import shardmap
+
+    store_url = _str_env("LO_STORE_URL")  # lo: allow[LO301] free-form URL
+    shard_groups = len([g for g in store_url.split(";") if g.strip()]) or 1
+    print(
+        f"shard config: groups={shard_groups} "
+        f"stripe_rows={shardmap.stripe_rows()} "
+        f"map_ttl_s={shardmap.map_ttl_s()}",
         flush=True,
     )
 

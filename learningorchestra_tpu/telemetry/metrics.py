@@ -361,6 +361,13 @@ def register_store(
     measurably cost, in records), and ``lo_store_unreplicated_acks``
     (sync-repl mode: writes acknowledged after the replication wait
     timed out)."""
+    if hasattr(store, "shard_occupancy"):
+        # a sharded client fronting N groups: per-shard gauges instead
+        # of the single-store family (every service create_app calls
+        # this entry point — the sharded fleet reports without any
+        # call-site changes)
+        register_sharded_store(store, registry=registry)
+        return
     stats_fn = getattr(store, "telemetry_stats", None)
     if stats_fn is None:
         return
@@ -418,5 +425,67 @@ def register_store(
             unreplicated_acks.labels(label).set(
                 role.get("unreplicated_acks", 0)
             )
+
+    registry.register_collector(collect)
+
+
+def register_sharded_store(
+    store: object, registry: Optional[MetricsRegistry] = None
+) -> None:
+    """Expose a sharded client's fleet view on ``/metrics``
+    (docs/observability.md, docs/dataplane.md): per-shard occupancy
+    gauges (``lo_store_shard_collections`` / ``_wal_bytes`` /
+    ``_spill_bytes``, labelled by shard index with the meta group at
+    ``0``), the last observed shard-map rev
+    (``lo_store_shardmap_rev``), and the scatter-gather fan-out
+    histogram (``lo_store_shard_fanout`` — how many groups each routed
+    call actually touched; a fleet whose reads keep fanning out to one
+    group is mis-striped). Occupancy is polled from each group's
+    ``/health`` at scrape time; a group mid-failover loses its gauges
+    for that scrape, never the endpoint. Idempotent per store
+    instance."""
+    registry = registry or global_registry()
+    key = id(store)
+    with _GLOBAL_LOCK:
+        if key in _REGISTERED_STORES:
+            return
+        _REGISTERED_STORES[key] = f"shard-fleet-{len(_REGISTERED_STORES)}"
+    shard_collections = registry.gauge(
+        "lo_store_shard_collections",
+        "Collections resident on the shard group",
+        labels=("shard",),
+    )
+    shard_wal_bytes = registry.gauge(
+        "lo_store_shard_wal_bytes",
+        "Bytes in the shard group's on-disk WAL",
+        labels=("shard",),
+    )
+    shard_spill_bytes = registry.gauge(
+        "lo_store_shard_spill_bytes",
+        "Bytes of column payloads the shard group spilled to disk",
+        labels=("shard",),
+    )
+    shardmap_rev = registry.gauge(
+        "lo_store_shardmap_rev",
+        "Last observed rev of the shard-map collection on the meta group",
+    )
+    fanout = registry.histogram(
+        "lo_store_shard_fanout",
+        "Shard groups touched per scatter-gather store call",
+        buckets=(1, 2, 4, 8, 16, 32),
+    )
+    # the client-side hook shardstore.ShardedStore calls with each
+    # routed call's width
+    store.on_fanout = fanout.observe
+
+    def collect(_registry: MetricsRegistry) -> None:
+        for shard, stats in enumerate(store.shard_occupancy()):
+            if not stats:
+                continue  # group unreachable this scrape
+            label = str(shard)
+            shard_collections.labels(label).set(stats.get("collections", 0))
+            shard_wal_bytes.labels(label).set(stats.get("wal_bytes", 0))
+            shard_spill_bytes.labels(label).set(stats.get("spill_bytes", 0))
+        shardmap_rev.set(store.shardmap_rev())
 
     registry.register_collector(collect)

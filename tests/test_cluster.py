@@ -497,6 +497,105 @@ class TestReplicationManifest:
             load({"enabled": True, "sync_repl": 2})
 
 
+class TestShardingManifest:
+    def test_sharding_section_plumbs_env_and_store_urls(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["sharding"] = {
+            "shards": 3,
+            "stripe_rows": 4096,
+            "map_ttl_s": 2,
+        }
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:
+            # ring placement is computed client-side on every machine:
+            # shards + stripe_rows must be cluster-wide identical
+            env = plan["env"]
+            assert env["LO_SHARDS"] == "3"
+            assert env["LO_SHARD_STRIPE_ROWS"] == "4096"
+            assert env["LO_SHARDMAP_TTL_S"] == "2"
+        # the worker's store URL is the `;`-joined multi-group grammar,
+        # one segment per group at store_port + 10*i
+        assert plans[1]["env"]["LO_STORE_URL"] == (
+            "http://10.0.0.1:27027;"
+            "http://10.0.0.1:27037;"
+            "http://10.0.0.1:27047"
+        )
+
+    def test_sharding_composes_with_replication(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["replication"] = {"enabled": True}
+        manifest["sharding"] = {"shards": 2}
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        # each `;` group keeps its own comma replica pair (primary at
+        # stride base, follower one above) so per-group client failover
+        # still works
+        assert plans[1]["env"]["LO_STORE_URL"] == (
+            "http://10.0.0.1:27027,http://10.0.0.1:27028;"
+            "http://10.0.0.1:27037,http://10.0.0.1:27038"
+        )
+
+    def test_no_section_means_degenerate_single_group(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        assert plans[1]["env"]["LO_STORE_URL"] == "http://10.0.0.1:27027"
+        for plan in plans:
+            assert "LO_SHARDS" not in plan["env"]
+
+    def test_sharding_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(sharding, extra=None):
+            manifest = _manifest()
+            manifest["sharding"] = sharding
+            for key, value in (extra or {}).items():
+                manifest[key] = value
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # shards 1 is the explicit degenerate form; ttl 0 = revalidate
+        # the map on every read — both valid
+        assert load({"shards": 1})["sharding"]["shards"] == 1
+        assert load({"map_ttl_s": 0})["sharding"]["map_ttl_s"] == 0
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"shards": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"shards": 2.5})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"shards": "4"})
+        with pytest.raises(SystemExit):
+            load({"shards": 0})
+        with pytest.raises(SystemExit):
+            load({"stripe_rows": 0})
+        with pytest.raises(SystemExit):
+            load({"map_ttl_s": -1})
+        with pytest.raises(SystemExit):
+            load({"map_ttl_s": True})
+        # a replication port landing inside a shard group's stride
+        # window (group 1 claims 27037..27039 here) must refuse
+        with pytest.raises(SystemExit):
+            load(
+                {"shards": 2},
+                extra={
+                    "replication": {
+                        "enabled": True,
+                        "follower_port": 27038,
+                    }
+                },
+            )
+
+
 class TestCoalescingManifest:
     def test_coalescing_section_plumbs_env_cluster_wide(self, tmp_path):
         cluster = _load_cluster_module()
