@@ -833,6 +833,241 @@ def bench_serve() -> dict:
         shutil.rmtree(models_dir, ignore_errors=True)
 
 
+def bench_fleet() -> dict:
+    """Fleet section: the replicated serving plane (docs/serving.md
+    "Fleet") at 1 / 2 / 4 replicas with 2 models. Each replica is a REAL
+    ``services.runner`` subprocess — its own GIL, its own XLA threadpool
+    — pinning its placement-assigned checkpoints and gossiping residency
+    through a store subprocess, exactly the production wiring.
+
+    Two load modes per replica count, both closed-loop
+    (serve/loadgen.py): **direct** spreads clients across the replica
+    ports (the aggregate-capacity ceiling), **router** aims everything
+    at one in-process fleet router (what clients actually see — placement
+    resolution + proxy overhead included). ``LO_FLEET_RF`` = replica
+    count (full replication), so aggregate pinned bytes must scale
+    ~linearly with replicas and every replica can serve every model.
+    The headlines are ``x2_predictions_scaling_ratio`` (>= 1.7 on a
+    multi-core box is the claim) and ``x4_pinned_bytes_ratio`` (~4);
+    ``cpu_cores`` rides in the output since the box caps scaling, same
+    as the shard section."""
+    import re
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    from learningorchestra_tpu.core.store_service import connect
+    from learningorchestra_tpu.ml.base import make_classifier
+    from learningorchestra_tpu.ml.checkpoint import checkpoint_path, save_model
+    from learningorchestra_tpu.serve import fleet as serve_fleet
+    from learningorchestra_tpu.serve import router as serve_router
+    from learningorchestra_tpu.serve.loadgen import (
+        HttpSession,
+        run_closed_loop,
+    )
+    from learningorchestra_tpu.utils.web import ServerThread
+
+    X, y = _synthetic(2_048, seed=11)
+    models = ["bench_fleet_alpha", "bench_fleet_beta"]
+    models_dir = tempfile.mkdtemp(prefix="lo_fleet_bench_")
+    for name in models:
+        save_model(
+            make_classifier("lr").fit(X, y), checkpoint_path(models_dir, name)
+        )
+    rows = X[:8].tolist()
+    clients = int(os.environ.get("LO_BENCH_FLEET_CLIENTS", "16"))
+    requests_per_client = int(os.environ.get("LO_BENCH_FLEET_REQUESTS", "50"))
+
+    def start_store():
+        env = dict(os.environ)
+        env["LO_STORE_PORT"] = "0"
+        env["PYTHONUNBUFFERED"] = "1"
+        # in-memory, own process: the section measures serving scale-out,
+        # not N WALs contending for one bench disk (bench_shard's rule)
+        for stale in ("LO_DATA_DIR", "LO_REPLICATE", "LO_PEERS",
+                      "LO_ARBITERS", "LO_PRIMARY_URL", "LO_NODE_ID"):
+            env.pop(stale, None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "learningorchestra_tpu.core.store_service"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            match = re.search(r"store server on [^:]+:(\d+)", line)
+            if match:
+                _drain(proc)
+                return proc, f"http://127.0.0.1:{match.group(1)}"
+        proc.kill()
+        raise RuntimeError("fleet store did not come up")
+
+    def _drain(proc):
+        # keep the child's stdout pipe from filling once we stop reading
+        threading.Thread(
+            target=lambda: all(True for _ in proc.stdout), daemon=True
+        ).start()
+
+    def start_replica(index: int, total: int, store_url: str):
+        env = dict(os.environ)
+        env.update(
+            {
+                "LO_SERVICE": "model_builder",
+                "LO_HOST": "127.0.0.1",
+                "LO_PORT": "0",
+                "LO_STORE_URL": store_url,
+                "LO_MODELS_DIR": models_dir,
+                "LO_FLEET_REPLICAS": str(total),
+                "LO_FLEET_RF": str(total),
+                "LO_FLEET_REPLICA": str(index),
+                "PYTHONUNBUFFERED": "1",
+            }
+        )
+        env.pop("LO_DATA_DIR", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "learningorchestra_tpu.services.runner"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            match = re.search(r"service model_builder on [\w.\-]+:(\d+)", line)
+            if match:
+                _drain(proc)
+                return proc, f"127.0.0.1:{match.group(1)}"
+        proc.kill()
+        raise RuntimeError(f"fleet replica {index} did not come up")
+
+    def wait_pinned(store, total: int) -> int:
+        """Block until every replica's gossip row shows both models
+        pinned AND warmed (the agent heartbeats only after its warmup
+        pass), then return the aggregate pinned bytes."""
+        deadline = time.monotonic() + 180
+        want = set(models)
+        while time.monotonic() < deadline:
+            try:
+                gossip = store.find(serve_fleet.HEARTBEAT_COLLECTION, {})
+            except Exception:  # noqa: BLE001 — store still booting
+                gossip = []
+            ready = [
+                row for row in gossip if want <= set(row.get("models", ()))
+            ]
+            if len(ready) >= total:
+                return int(sum(row.get("pinned_bytes", 0) for row in ready))
+            time.sleep(0.5)
+        raise RuntimeError("fleet replicas did not pin within budget")
+
+    def drive(targets: list) -> dict:
+        """Closed loop over BOTH models: client i connects to
+        targets[i % n] and requests models[i % m] — multi-target mode
+        when targets are the replica ports, router mode when targets
+        is the router's one URL."""
+
+        def session_factory(index: int) -> HttpSession:
+            return HttpSession(targets[index % len(targets)])
+
+        def send(index: int, session: HttpSession) -> None:
+            name = models[index % len(models)]
+            status, body = session.post_json(
+                f"/models/{name}/predict", {"rows": rows}
+            )
+            if status != 200:
+                raise RuntimeError(
+                    f"predict {name} via {session.target}: HTTP {status} "
+                    f"{body}"
+                )
+
+        return run_closed_loop(
+            send,
+            clients,
+            requests_per_client,
+            rows_per_request=len(rows),
+            session_factory=session_factory,
+        )
+
+    out: dict = {
+        "models": len(models),
+        "clients": clients,
+        "requests_per_client": requests_per_client,
+        "rows_per_request": len(rows),
+        "cpu_cores": os.cpu_count(),
+    }
+    baseline: Optional[dict] = None
+    try:
+        for replicas in (1, 2, 4):
+            if _budget_left() < 90:
+                out[f"replicas{replicas}"] = {"skipped": "budget"}
+                continue
+            procs: list = []
+            store = None
+            router_server = None
+            try:
+                store_proc, store_url = start_store()
+                procs.append(store_proc)
+                targets = []
+                for index in range(replicas):
+                    proc, target = start_replica(index, replicas, store_url)
+                    procs.append(proc)
+                    targets.append(target)
+                store = connect(store_url)
+                pinned_bytes = wait_pinned(store, replicas)
+                direct = drive(targets)
+                router_app = serve_router.create_app(
+                    store,
+                    placement=serve_fleet.PlacementClient(
+                        store, replicas=replicas, rf=replicas
+                    ),
+                )
+                router_server = ServerThread(router_app, "127.0.0.1", 0)
+                router_server.start()
+                routed = drive([f"127.0.0.1:{router_server.port}"])
+                entry = {
+                    "aggregate_pinned_bytes": pinned_bytes,
+                    "direct": direct,
+                    "router": routed,
+                }
+                out[f"replicas{replicas}"] = entry
+                if baseline is None:
+                    baseline = entry
+                else:
+                    out[f"x{replicas}_predictions_scaling_ratio"] = round(
+                        direct["predictions_per_s"]
+                        / baseline["direct"]["predictions_per_s"],
+                        2,
+                    )
+                    out[f"x{replicas}_pinned_bytes_ratio"] = round(
+                        pinned_bytes
+                        / max(baseline["aggregate_pinned_bytes"], 1),
+                        2,
+                    )
+            finally:
+                if router_server is not None:
+                    router_server.stop()
+                if store is not None:
+                    store.close()
+                for proc in procs:
+                    proc.terminate()
+                for proc in procs:
+                    try:
+                        proc.wait(timeout=10)
+                    except Exception:  # noqa: BLE001
+                        proc.kill()
+        return out
+    finally:
+        shutil.rmtree(models_dir, ignore_errors=True)
+
+
 def _rss_bytes() -> int:
     """Current resident set (bytes) from /proc — ru_maxrss is a peak,
     not a level, so it cannot see waiters RELEASING memory."""
@@ -1962,6 +2197,7 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
     section("wire", bench_wire)  # transport head-to-head (v1/v2/shm)
     section("shard", bench_shard)  # scatter-gather scaling at 1/2/4 groups
     section("serve", bench_serve)  # the online predict lane's latency
+    section("fleet", bench_fleet)  # scale-out serving at 1/2/4 replicas
     section("waiters", bench_waiters)  # push job completion (docs/web.md)
     section("coalesce", bench_coalesce)  # vmap-across-jobs dispatch
     section("obs", lambda: bench_obs(X, y))  # fleet plane's own cost
@@ -2016,6 +2252,17 @@ def main(compare_path: Optional[str] = None, threshold: float = 0.25) -> int:
                 "p99_ms": top.get("p99_ms"),
                 "predictions_per_s": top.get("predictions_per_s"),
                 "mean_batch_size": top.get("mean_batch_size"),
+            }
+    fleet = extra.get("fleet")
+    if isinstance(fleet, dict):
+        two = fleet.get("replicas2", {})
+        direct = two.get("direct") if isinstance(two, dict) else None
+        if isinstance(direct, dict) and "predictions_per_s" in direct:
+            summary["fleet_2r"] = {
+                "predictions_per_s": direct.get("predictions_per_s"),
+                "p99_ms": direct.get("p99_ms"),
+                "scaling_ratio": fleet.get("x2_predictions_scaling_ratio"),
+                "pinned_bytes": two.get("aggregate_pinned_bytes"),
             }
     waiters = extra.get("waiters")
     if isinstance(waiters, dict):

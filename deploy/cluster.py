@@ -67,6 +67,12 @@ Manifest (JSON)::
         "queue_cap": 256,          #   LO_SERVE_QUEUE_CAP (429 past it)
         "timeout_s": 30            #   LO_SERVE_TIMEOUT_S (> 0)
       },
+      "fleet": {                   # optional replicated serving fleet
+        "replicas": 2,             #   LO_FLEET_REPLICAS (N replica
+        "rf": 1,                   #   model_builders + router, single-
+        "model_qps": 0,            #   host stacks only) / LO_FLEET_RF /
+        "down_s": 3                #   LO_FLEET_MODEL_QPS (0 = off) /
+      },                           #   LO_FLEET_DOWN_S (docs/serving.md)
       "profiling": {               # optional flight-recorder knobs
         "prof_hz": 47,             #   LO_PROF_HZ (0 disables /debug/
         "prof_window_s": 60        #   profile); LO_PROF_WINDOW_S (> 0)
@@ -248,6 +254,26 @@ def load_manifest(path: str) -> dict:
                 raise SystemExit("serving.timeout_s must be > 0")
         elif value < 1:
             raise SystemExit(f"serving.{key} must be >= 1")
+    fleet = manifest.setdefault("fleet", {})
+    for key in fleet:
+        if key not in _FLEET_KNOBS:
+            raise SystemExit(
+                f"unknown fleet knob {key!r} (have: "
+                f"{', '.join(sorted(_FLEET_KNOBS))})"
+            )
+        value = fleet[key]
+        # same bool-is-int trap as the sched knobs: `"replicas": true`
+        # would stringify to "True" and fail every preflight downstream
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SystemExit(f"fleet.{key} must be a number")
+        if key in ("replicas", "rf"):
+            if not isinstance(value, int) or value < 1:
+                raise SystemExit(f"fleet.{key} must be an integer >= 1")
+        elif key == "model_qps":
+            if value < 0:  # 0 = per-model quota off, still valid
+                raise SystemExit("fleet.model_qps must be >= 0")
+        elif value <= 0:  # down_s
+            raise SystemExit("fleet.down_s must be > 0")
     profiling = manifest.setdefault("profiling", {})
     for key in profiling:
         if key not in _PROFILING_KNOBS:
@@ -491,6 +517,18 @@ _SERVING_KNOBS = {
     "timeout_s": "LO_SERVE_TIMEOUT_S",
 }
 
+# manifest fleet.<knob> -> the env var every machine receives
+# (docs/serving.md "Fleet"). Plumbed cluster-wide like the serving
+# knobs so a promoted head inherits the same fleet shape, but only a
+# single-host stack ACTS on LO_FLEET_REPLICAS — stack.py's multi-host
+# topology logs it as ignored (the coordinator serves predicts itself).
+_FLEET_KNOBS = {
+    "replicas": "LO_FLEET_REPLICAS",
+    "rf": "LO_FLEET_RF",
+    "model_qps": "LO_FLEET_MODEL_QPS",
+    "down_s": "LO_FLEET_DOWN_S",
+}
+
 # manifest profiling.<knob> -> the env var every machine receives
 # (docs/profiling.md). Cluster-wide: a stall diagnosis must be able to
 # hit /debug/profile on ANY member, so no machine may silently run with
@@ -640,6 +678,9 @@ def machine_plans(manifest: dict) -> list[dict]:
     for knob, env_var in _SERVING_KNOBS.items():
         if knob in manifest.get("serving", {}):
             shared[env_var] = str(manifest["serving"][knob])
+    for knob, env_var in _FLEET_KNOBS.items():
+        if knob in manifest.get("fleet", {}):
+            shared[env_var] = str(manifest["fleet"][knob])
     for knob, env_var in _PROFILING_KNOBS.items():
         if knob in manifest.get("profiling", {}):
             shared[env_var] = str(manifest["profiling"][knob])

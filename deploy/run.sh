@@ -58,6 +58,22 @@
 #                            get HTTP 429 + Retry-After    (default 256)
 #   LO_SERVE_TIMEOUT_S       per-request wait bound → 503  (default 30)
 #
+# Serving-fleet knobs (docs/serving.md "Fleet" has the full table; the
+# fleet only launches under deploy/stack.py with LO_FLEET_REPLICAS set):
+#   LO_FLEET_REPLICAS     replica model_builder processes behind the
+#                         router (strictly integral >= 1; unset = no
+#                         fleet — the single reference model_builder)
+#   LO_FLEET_RF           placement copies per model on the consistent-
+#                         hash ring (default 1; clamped to the replica
+#                         count; strictly integral >= 1)
+#   LO_FLEET_MODEL_QPS    router per-model token-bucket rate; past it
+#                         predicts get 429 + Retry-After (default 0 =
+#                         quota off; >= 0)
+#   LO_FLEET_DOWN_S       heartbeat staleness after which the router
+#                         routes AROUND a replica (default 3; > 0)
+#   LO_FLEET_REPLICA      this process's replica index — set by
+#                         stack.py per child, never by an operator
+#
 # Web-serving knobs (docs/web.md has the full table):
 #   LO_WEB_ASYNC          1 = selectors event-loop serving core (idle
 #                         keep-alive/long-poll connections cost no
@@ -180,6 +196,12 @@ dtypepolicy.validate_env()
 # (window >= 0, bytes >= 0 with 0 = host-only fallback)
 from learningorchestra_tpu.serve import config as serve_config
 serve_config.validate_all()
+# serving-fleet knobs: replica count / rf strictly integral >= 1,
+# quota rate >= 0 (0 = off), down threshold > 0, replica index (set by
+# stack.py, not operators) integral and < the replica count — a typo'd
+# LO_FLEET_RF must refuse bring-up, never silently place single-copy
+from learningorchestra_tpu.serve import fleet as serve_fleet
+serve_fleet.validate_env()
 # profiling knobs: HZ >= 0 (0 = /debug/profile disabled), window > 0
 from learningorchestra_tpu.telemetry import profile as lo_profile
 lo_profile.validate_env()

@@ -48,6 +48,13 @@ Environment (all optional):
 - ``LO_FOLLOWER_PORT``  follower store port (default 27028)
 - ``LO_ARBITER_PORT``   arbiter port (default 27029)
 - ``LO_AUTO_PROMOTE_S`` follower takeover timer, quorum-gated (default 5)
+- ``LO_FLEET_REPLICAS`` N >= 1 additionally launches the serving fleet
+                        (docs/serving.md "Fleet"): N replica
+                        model_builder processes (``LO_FLEET_REPLICA=i``,
+                        ports 5010+i — NOT 5002+i, which would collide
+                        with the reference ports) behind one
+                        ``LO_SERVICE=router`` process on 5007; unset =
+                        no fleet children. Single-host topology only.
 - ``LO_STACK_EXIT_ON_STDIN_EOF``  "1" = shut the stack down when stdin
                         hits EOF. Set by deploy/cluster.py's ssh
                         transport: killing the ssh CLIENT never signals
@@ -96,6 +103,15 @@ SERVICE_NAMES = (
     "tsne",
     "pca",
 )
+
+# The replicated serving fleet (docs/serving.md "Fleet"), opt-in via
+# LO_FLEET_REPLICAS: N extra model_builder processes carrying
+# LO_FLEET_REPLICA=<i> (each runs a ReplicaAgent pinning its
+# placement-assigned models) behind one LO_SERVICE=router process. The
+# replicas bind FLEET_PORT_BASE+i — a separate base, NOT 5002+i, which
+# would collide with the reference ports 5003-5006.
+ROUTER_PORT = 5007
+FLEET_PORT_BASE = 5010
 
 # "service <name> on <host>:<port>" (services/runner.py) and
 # "store server on <host>:<port>" (core/store_service.py)
@@ -382,6 +398,24 @@ def main() -> int:
     except ValueError:
         log(f"[stack] LO_SHARDS must be an integer >= 1, got {shards_raw!r}")
         return 2
+    # Replicated serving fleet (docs/serving.md "Fleet"): opt-in via
+    # LO_FLEET_REPLICAS=N — N replica model_builder processes (each a
+    # ReplicaAgent pinning its placement-assigned models) behind one
+    # router. run.sh preflights the knob; this parse re-checks because
+    # cluster.py launches stack.py directly.
+    fleet_raw = os.environ.get("LO_FLEET_REPLICAS", "").strip()
+    fleet_replicas = 0
+    if fleet_raw:
+        try:
+            fleet_replicas = int(fleet_raw)
+            if fleet_replicas < 1:
+                raise ValueError(fleet_raw)
+        except ValueError:
+            log(
+                "[stack] LO_FLEET_REPLICAS must be an integer >= 1, "
+                f"got {fleet_raw!r}"
+            )
+            return 2
     if shards > 1 and process_base_early == 0:
         if store_port == "0":
             log("[stack] LO_SHARDS>1 needs a fixed LO_STORE_PORT")
@@ -452,8 +486,14 @@ def main() -> int:
             for name, child in children.items()
             if child.port is not None
         }
-        for child in children.values():  # all-in-one runners: per-service
-            ports.update(child.service_ports)
+        for name, child in children.items():  # all-in-one: per-service
+            if name.startswith("replica") and child.service_ports:
+                # fleet replicas all announce "service model_builder";
+                # publish under replica<i> so they don't clobber the
+                # reference model_builder's port (or each other's)
+                ports[name] = next(iter(child.service_ports.values()))
+            else:
+                ports.update(child.service_ports)
         state = {
             "ports": ports,
             "pids": {
@@ -499,6 +539,11 @@ def main() -> int:
         elif workers > 0 or total_processes > 1:
             # total > 1 with no local workers = the head machine of a
             # cross-machine runtime whose workers all live elsewhere
+            if fleet_replicas:
+                log(
+                    "[stack] LO_FLEET_REPLICAS ignored in the multi-host "
+                    "topology (the coordinator serves predicts itself)"
+                )
             exit_code = _supervise_multihost(
                 children,
                 store,
@@ -527,6 +572,7 @@ def main() -> int:
                 ports_path,
                 stopping,
                 log,
+                fleet_replicas=fleet_replicas,
             )
     finally:
         log("[stack] shutting down")
@@ -554,18 +600,41 @@ def _supervise(
     ports_path,
     stopping,
     log,
+    fleet_replicas: int = 0,
 ) -> int:
     service_store_url = _start_store_plane(children, store, host, log)
     # the META group's primary (first ';' group, first ',' replica) —
     # the url the store-restart re-point logic below tracks
     store_url = service_store_url.split(";")[0].split(",")[0]
 
-    for name in SERVICE_NAMES:
+    launch_names = list(SERVICE_NAMES)
+    fleet_names = []
+    if fleet_replicas:
+        # the fleet children ride the same supervision loop as the
+        # seven: named replica<i>/router in children, restarted on
+        # failure, ports published in stack_ports.json
+        fleet_names = [f"replica{i}" for i in range(fleet_replicas)]
+        fleet_names.append("router")
+        launch_names += fleet_names
+    for name in launch_names:
         env = dict(base_env)
-        env["LO_SERVICE"] = name
         env["LO_STORE_URL"] = service_store_url
-        if ephemeral:
-            env["LO_PORT"] = "0"
+        if name.startswith("replica"):
+            index = int(name[len("replica"):])
+            env["LO_SERVICE"] = "model_builder"
+            env["LO_FLEET_REPLICA"] = str(index)
+            env["LO_PORT"] = "0" if ephemeral else str(FLEET_PORT_BASE + index)
+        elif name == "router":
+            env["LO_SERVICE"] = "router"
+            env["LO_PORT"] = "0" if ephemeral else str(ROUTER_PORT)
+            env.pop("LO_FLEET_REPLICA", None)
+        else:
+            env["LO_SERVICE"] = name
+            # replica membership is per-process: never inherited from
+            # the supervisor's own environment
+            env.pop("LO_FLEET_REPLICA", None)
+            if ephemeral:
+                env["LO_PORT"] = "0"
         child = Child(
             name,
             [sys.executable, "-m", "learningorchestra_tpu.services.runner"],
@@ -574,9 +643,14 @@ def _supervise(
         )
         children[name] = child
         child.start()
-    for name in SERVICE_NAMES:
+    for name in launch_names:
         children[name].wait_port(120)
     write_ports()
+    if fleet_names:
+        log(
+            f"[stack] serving fleet up: {fleet_replicas} replica(s) + "
+            "router"
+        )
     log(f"[stack] all services up; ports in {ports_path}")
 
     retired: set = set()
@@ -626,7 +700,7 @@ def _supervise(
                         f"{new_url}; restarting services to rewire"
                     )
                     store_url = new_url
-                    for svc_name in SERVICE_NAMES:
+                    for svc_name in launch_names:
                         svc = children[svc_name]
                         svc.terminate()
                         svc.env["LO_STORE_URL"] = store_url

@@ -15,7 +15,11 @@ serving lane (``Model.predict(model_name, rows)`` /
 labels + probabilities, no polling; docs/serving.md) and hyperparameter
 sweeps (``Model.sweep(..., grid, sweep_name)`` → ``POST /models/sweep``
 — a λ/depth grid fitted as ONE fused device dispatch, per-point metrics
-plus the argmax checkpoint; docs/model_builder.md).
+plus the argmax checkpoint; docs/model_builder.md). ``Model.predict``
+also rides a replicated serving fleet transparently: pointed at a fleet
+router URL (``Context("host:5007")``), it detects the router by its
+``/health`` feature probe and honors per-model-quota 429 + Retry-After
+(docs/serving.md "Fleet").
 """
 
 from learningorchestra_tpu.client import (  # noqa: F401

@@ -489,9 +489,45 @@ class DataTypeHandler(_RestClient):
 class Model(_RestClient):
     MODEL_BUILDER_PORT = "5002"
     _RESOURCE = "models"
+    # one probe per cluster base URL per process (the AsyncronousWait
+    # push-probe idiom): does the base URL front a fleet router?
+    _router_probe_cache: dict = {}
 
     def __init__(self):
         super().__init__(self.MODEL_BUILDER_PORT)
+
+    def _router_base(self):
+        """The fleet router's base URL, or ``None`` for the classic
+        direct-to-model_builder topology.
+
+        A fleet deployment (docs/serving.md "Fleet") fronts predicts
+        with ONE router URL instead of the per-service port table:
+        ``Context("host:5007")`` points the client at it, and this
+        probe — one ``GET /health`` per base URL per process, cached —
+        detects the ``"fleet_router"`` feature field the router
+        advertises (serve/router.py). Everything else about the client
+        is unchanged: batch calls still go to the head's service ports,
+        so fleet users give the data-plane classes a separate
+        ``Context`` at the head."""
+        base = cluster_url
+        if not base:
+            return None
+        cached = self._router_probe_cache.get(base)
+        if cached is None:
+            try:
+                response = requests.get(
+                    base + "/health",
+                    headers=_correlation_headers(),
+                    timeout=2,
+                )
+                cached = bool(
+                    response.status_code == 200
+                    and response.json().get("fleet_router")
+                )
+            except (requests.RequestException, ValueError):
+                cached = False
+            self._router_probe_cache[base] = cached
+        return base if cached else None
 
     def create_model(
         self,
@@ -524,14 +560,35 @@ class Model(_RestClient):
         """Synchronous predictions from a built model: ``rows`` (a list
         of numeric feature rows) in, labels + probabilities out — no job
         to poll. The 429/Retry-After and 404 cases surface through the
-        standard ``ResponseTreat`` semantics."""
+        standard ``ResponseTreat`` semantics.
+
+        Transparently rides a fleet router when the ``Context`` URL
+        fronts one (:meth:`_router_base`): the request goes to the
+        router's ``/models/<name>/predict`` and a per-model-quota 429
+        is honored by sleeping out its ``Retry-After`` (the
+        AsyncronousWait backoff clamp) and retrying, so a burst over
+        ``LO_FLEET_MODEL_QPS`` smooths out instead of raising."""
         if pretty_response:
             _banner(" PREDICT WITH " + model_name + " ")
-        return self._post(
-            model_name + "/predict",
-            body={"rows": rows},
-            pretty_response=pretty_response,
-        )
+        router = self._router_base()
+        if router is None:
+            return self._post(
+                model_name + "/predict",
+                body={"rows": rows},
+                pretty_response=pretty_response,
+            )
+        url = f"{router}/models/{urllib.parse.quote(model_name, safe='')}/predict"
+        while True:
+            response = requests.post(
+                url,
+                json={"rows": rows},
+                headers=_correlation_headers(),
+                timeout=self._TIMEOUT_S,
+            )
+            if response.status_code == 429:
+                self.asyncronous_wait._sleep_retry_after(response)
+                continue
+            return self._treat(response, pretty_response)
 
     def list_models(self, pretty_response: bool = True):
         """Built model names plus serving-registry occupancy."""

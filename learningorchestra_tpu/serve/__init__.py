@@ -17,6 +17,11 @@ already persists (``ml/checkpoint.py``) into an interactive surface:
 - :class:`ServePlane` owns one of each — the unit the model_builder
   service wires behind ``POST /models/<name>/predict``
   (docs/serving.md).
+- :mod:`~learningorchestra_tpu.serve.fleet` and
+  :mod:`~learningorchestra_tpu.serve.router` scale the plane OUT:
+  consistent-hash model placement over N replicas, residency gossip on
+  the store, and a placement-aware proxy riding the event-loop server
+  (docs/serving.md "Fleet").
 
 One process-wide plane (:func:`global_serve_plane`) serves production;
 tests construct standalone planes with explicit knobs.

@@ -182,6 +182,14 @@ class ModelRegistry:
             self._metrics["bytes"].set(self.bytes)
             self._metrics["models"].set(len(self._entries))
 
+    def release(self, path: str) -> None:
+        """Unpin ``path`` (no-op when not resident): the fleet's
+        replica agent returns the byte budget when a model's placement
+        moves to another replica. Not an invalidation — the artifact
+        is fine, this replica just no longer owns it."""
+        with self._lock:
+            self._drop_locked(os.path.abspath(path))
+
     def status(self, path: str) -> dict:
         """Residency info for ``GET /models/<name>`` — no load."""
         path = os.path.abspath(path)

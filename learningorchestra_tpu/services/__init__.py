@@ -38,3 +38,7 @@ DATA_TYPE_HANDLER_PORT = 5003
 HISTOGRAM_PORT = 5004
 TSNE_PORT = 5005
 PCA_PORT = 5006
+# Beyond the reference table: the fleet router (serve/router.py) — the
+# one client-facing URL in front of N serving replicas. Launched as
+# LO_SERVICE=router, never part of the all-in-one seven.
+ROUTER_PORT = 5007

@@ -74,6 +74,15 @@ Environment:
   micro-batch collection window, the per-dispatch request cap, the
   per-request row cap (413 past it), the bounded batcher inbox (429 +
   Retry-After past it), and the per-request wait bound.
+- ``LO_FLEET_REPLICAS`` / ``LO_FLEET_RF`` / ``LO_FLEET_MODEL_QPS`` /
+  ``LO_FLEET_DOWN_S`` — the replicated serving fleet (docs/serving.md
+  "Fleet"): replica count, owners per model on the consistent-hash
+  placement ring, the router's per-model admission quota, and the
+  heartbeat age past which a replica is routed around. A replica
+  process additionally carries ``LO_FLEET_REPLICA=<index>`` (set by
+  the supervisor — deploy/stack.py — not by operators), which arms the
+  per-process :class:`~learningorchestra_tpu.serve.fleet.ReplicaAgent`;
+  the router itself is ``LO_SERVICE=router`` (default port 5007).
 - ``LO_COALESCE_WINDOW_MS`` / ``LO_COALESCE_MAX_JOBS`` — the job
   coalescer (docs/scheduler.md): shape-compatible device jobs arriving
   within the window fuse into ONE vmap-across-jobs dispatch (0 =
@@ -112,6 +121,7 @@ from learningorchestra_tpu.services import (
     MODEL_BUILDER_PORT,
     PCA_PORT,
     PROJECTION_PORT,
+    ROUTER_PORT,
     TSNE_PORT,
 )
 from learningorchestra_tpu.services import (
@@ -289,6 +299,14 @@ def build_app(
         return data_type_handler.create_app(store, jobs)
     if name == "histogram":
         return histogram.create_app(store, jobs)
+    if name == "router":
+        # The fleet router (serve/router.py): placement-aware predict
+        # proxy + residency view, launched as its own LO_SERVICE —
+        # never part of the all-in-one seven (start_all), because a
+        # router in front of zero replicas routes nothing.
+        from learningorchestra_tpu.serve import router as _router
+
+        return _router.create_app(store)
     if name in ("tsne", "pca"):
         create = None
         if dispatcher is not None:
@@ -431,6 +449,15 @@ def main() -> None:
     from learningorchestra_tpu.serve import config as serve_config
 
     print(f"serving config: {serve_config.validate_all()}", flush=True)
+
+    # ...and the fleet knobs (docs/serving.md "Fleet"): an operator
+    # should see at boot whether this process is a fleet replica (and
+    # which index) or a plain single serving plane, and a typo'd
+    # LO_FLEET_RF must refuse bring-up, never silently place models
+    # with the wrong replication
+    from learningorchestra_tpu.serve import fleet as serve_fleet
+
+    print(f"fleet config: {serve_fleet.validate_env()}", flush=True)
 
     # ...and the coalescing knobs (docs/scheduler.md): window 0 means
     # passthrough, which an operator should see stated at boot
@@ -615,7 +642,10 @@ def main() -> None:
         )
 
     if service:
-        port = _int_env("LO_PORT", SERVICES[service])
+        port = _int_env(
+            "LO_PORT",
+            ROUTER_PORT if service == "router" else SERVICES[service],
+        )
         server = ServerThread(
             build_app(service, store, images_dir, dispatcher, models_dir, jobs),
             host,
@@ -624,6 +654,28 @@ def main() -> None:
         server.start()
         print(f"service {service} on {host}:{server.port}", flush=True)
         servers = [server]
+        if (
+            service == "model_builder"
+            and serve_fleet.replica_index() is not None
+        ):
+            # This process is a fleet replica: run the agent that pins
+            # this replica's placement-assigned checkpoints (warming
+            # them at the serve shape) and heartbeats residency into
+            # the store the router reads. Uses the process-wide plane —
+            # the same one create_app serves predicts from.
+            from learningorchestra_tpu.serve import global_serve_plane
+
+            agent = serve_fleet.ReplicaAgent(
+                store,
+                models_dir or "",
+                global_serve_plane(),
+                url=f"http://{host}:{server.port}",
+            ).start()
+            print(
+                f"fleet replica {agent.index}: agent started "
+                f"(interval {agent.interval_s}s)",
+                flush=True,
+            )
     else:
         _, servers = start_all(
             store,

@@ -88,6 +88,13 @@ FAULT_POINTS = {
         "(ctx: path, requests — an error here must become per-request "
         "errors, not a dropped group)"
     ),
+    "serve.route": (
+        "fleet router, after admission but before the predict proxies "
+        "upstream (ctx: model — an error here must answer a clean JSON "
+        "503, never take the router down; a delay holds the routing "
+        "decision open while a replica dies, the in-flight-failover "
+        "chaos drill)"
+    ),
 }
 
 _ACTIONS = ("kill", "delay", "error", "torn")

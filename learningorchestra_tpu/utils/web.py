@@ -24,7 +24,10 @@ from werkzeug.wrappers import Request, Response
 from learningorchestra_tpu.telemetry import metrics as _metrics
 from learningorchestra_tpu.telemetry import tracing as _tracing
 from learningorchestra_tpu.utils import webloop as _webloop
-from learningorchestra_tpu.utils.webloop import Waiter  # noqa: F401 — re-export
+from learningorchestra_tpu.utils.webloop import (  # noqa: F401 — re-export
+    Upstream,
+    Waiter,
+)
 
 
 def jsonify(payload: Any) -> Response:
@@ -449,9 +452,10 @@ class WebApp:
             # e.g. BadRequest from request.get_json() on a malformed
             # body — keep its real status code, don't convert to a 500.
             return error.get_response(request.environ)
-        if isinstance(result, Waiter):
-            # the answer isn't ready: __call__ parks it (event loop) or
-            # blocks on it (threaded server / test client)
+        if isinstance(result, (Waiter, Upstream)):
+            # the answer isn't ready / lives on another server:
+            # __call__ parks or proxies it (event loop) or resolves it
+            # blocking (threaded server / test client)
             return result
         if isinstance(result, Response):
             return result
@@ -502,6 +506,35 @@ class WebApp:
         _tracing.export_trace(trace, service=self.name)
         route = environ.get("lo.route", "<unmatched>")
         method = request.method
+        if isinstance(response, Upstream):
+            upstream = response
+            upstream.correlation_id = correlation_id
+            if environ.get("lo.async"):
+                # Event-loop server: the loop proxies on its own thread
+                # — this pooled thread is released immediately. Metrics
+                # record at relay time, like a parked waiter's. A
+                # route-set on_complete (the router's own families)
+                # chains in front rather than being replaced.
+                route_complete = upstream.on_complete
+
+                def complete(status, _route=route, _method=method):
+                    if route_complete is not None:
+                        route_complete(status)
+                    self._requests_total.labels(
+                        self.name, _route, _method, status
+                    ).inc()
+                    self._request_seconds.labels(
+                        self.name, _route, _method
+                    ).observe(time.perf_counter() - started)
+
+                upstream.on_complete = complete
+                environ["lo.upstream"] = upstream
+                start_response("204 No Content", [])
+                return [b""]
+            # Threaded server / test client: walk the targets blocking
+            # on this request thread.
+            status, headers, body = upstream.resolve_blocking()
+            response = Response(body, status=status, headers=headers)
         if isinstance(response, Waiter):
             waiter = response
             waiter.correlation_id = correlation_id

@@ -16,7 +16,13 @@ layer with a reactor (stdlib only):
   a response; the loop parks the CONNECTION (no thread) until the
   waiter is notified, times out, or its poll interval finds the
   answer. ``GET /jobs/<name>/wait`` and ``GET /wal?wait=`` both ride
-  this.
+  this;
+- a route whose answer lives on ANOTHER server returns an
+  :class:`Upstream`: the loop connects out non-blocking in the same
+  selector, relays the request, and streams the reply back through the
+  ordinary write-readiness machinery — fd + memcpy on the loop thread,
+  failing over target-by-target on connection death or 5xx. The fleet
+  router (serve/router.py) rides this.
 
 The WSGI contract is untouched: ``utils/web.WebApp`` still serves
 werkzeug's test client directly, and ``LO_WEB_ASYNC=0`` falls back to
@@ -180,6 +186,177 @@ class Waiter:
             self._event.clear()
 
 
+# ---------------------------------------------------------------------------
+# Upstream: a response that lives on another server
+
+# end-to-end framing the proxy owns; everything else relays verbatim
+_HOP_HEADERS = ("connection", "keep-alive", "content-length", "transfer-encoding")
+
+
+def _relay_headers(headers: list) -> list:
+    return [
+        (key, value)
+        for key, value in headers
+        if key.lower() not in _HOP_HEADERS
+    ]
+
+
+def _parse_http_response(buf, eof: bool) -> Optional[tuple]:
+    """One upstream HTTP/1.1 response out of ``buf``: ``None`` while
+    incomplete, else ``(status, reason, headers, body)``. Raises
+    ``ValueError`` on a reply the proxy cannot frame (bad status line,
+    chunked body) — callers treat that as attempt failure. With no
+    Content-Length the body is EOF-terminated (the proxy sends
+    ``Connection: close``, so the peer's FIN frames it)."""
+    head_end = buf.find(b"\r\n\r\n")
+    if head_end < 0:
+        if len(buf) > _MAX_HEADER_BYTES:
+            raise ValueError("upstream response head too large")
+        return None
+    lines = bytes(buf[:head_end]).split(b"\r\n")
+    parts = lines[0].split(None, 2)
+    if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
+        raise ValueError("bad upstream status line")
+    status = int(parts[1])
+    reason = parts[2].decode("latin-1") if len(parts) > 2 else ""
+    headers = []
+    length = None
+    for line in lines[1:]:
+        key, sep, value = line.partition(b":")
+        if not sep:
+            raise ValueError("bad upstream header")
+        name = key.strip().decode("latin-1")
+        text = value.strip().decode("latin-1")
+        headers.append((name, text))
+        lower = name.lower()
+        if lower == "content-length":
+            length = int(text)
+        elif lower == "transfer-encoding" and "chunked" in text.lower():
+            raise ValueError("chunked upstream body unsupported")
+    body_start = head_end + 4
+    if length is None:
+        if not eof:
+            return None
+        body = bytes(buf[body_start:])
+    else:
+        if len(buf) - body_start < length:
+            return None
+        body = bytes(buf[body_start:body_start + length])
+    return status, reason, headers, body
+
+
+class Upstream:
+    """A proxied response. A route handler returns one INSTEAD of a
+    ``(payload, status)`` result when the answer lives on another
+    server (the fleet router's predict path, serve/router.py):
+
+    - ``targets`` is the ordered ``(host, port)`` failover list;
+      ``raw_request`` the pre-serialized HTTP request to relay (built
+      with ``Connection: close`` so the peer's FIN frames a
+      length-less body);
+    - a connection failure, torn/unparseable reply, per-attempt
+      ``timeout_s``, or 5xx answer advances to the next target; the
+      first non-5xx reply relays verbatim minus hop-by-hop headers;
+    - with every target down the last 5xx seen relays (the real error
+      beats a synthetic one), else ``on_exhausted()`` supplies the
+      ``(payload, status)`` for a clean JSON 502;
+    - ``on_attempt(index, target)`` observes every attempt start (the
+      router counts ``index > 0`` as retries — it runs on the loop
+      thread, keep it cheap); ``on_complete(status)`` is set by
+      ``WebApp.__call__`` and records request metrics at relay time,
+      exactly like :class:`Waiter`.
+
+    The event loop drives the whole exchange on the loop thread — no
+    proxy thread per request. The threaded server (and the test
+    client) resolves with :meth:`resolve_blocking` instead.
+    """
+
+    __slots__ = (
+        "targets", "raw_request", "timeout_s", "on_attempt",
+        "on_exhausted", "on_complete", "correlation_id",
+    )
+
+    def __init__(
+        self,
+        targets,
+        raw_request: bytes,
+        timeout_s: float = 30.0,
+        on_attempt: Optional[Callable[[int, tuple], None]] = None,
+        on_exhausted: Optional[Callable[[], tuple]] = None,
+    ):
+        if not targets:
+            raise ValueError("Upstream needs at least one target")
+        self.targets = [(host, int(port)) for host, port in targets]
+        self.raw_request = bytes(raw_request)
+        self.timeout_s = float(timeout_s)
+        self.on_attempt = on_attempt
+        self.on_exhausted = on_exhausted or (
+            lambda: ({"result": "bad_gateway"}, 502)
+        )
+        self.on_complete: Optional[Callable[[int], None]] = None
+        self.correlation_id: Optional[str] = None
+
+    def resolve_blocking(self) -> tuple[int, list, bytes]:
+        """Threaded-server path: walk the targets with blocking sockets
+        on THIS thread. Returns ``(status, headers, body)`` with
+        hop-by-hop headers already stripped."""
+        last_5xx = None
+        for index, target in enumerate(self.targets):
+            if self.on_attempt is not None:
+                try:
+                    self.on_attempt(index, target)
+                except Exception:  # noqa: BLE001 — observer must not kill
+                    traceback.print_exc()
+            try:
+                parsed = self._attempt_blocking(target)
+            except (OSError, ValueError):
+                continue
+            status = parsed[0]
+            if status >= 500:
+                last_5xx = parsed
+                continue
+            break
+        else:
+            if last_5xx is None:
+                payload, status = self.on_exhausted()
+                body = json.dumps(payload).encode("utf-8")
+                self._completed(status)
+                return status, [("Content-Type", "application/json")], body
+            parsed = last_5xx
+        status, _reason, headers, body = parsed
+        self._completed(status)
+        return status, _relay_headers(headers), body
+
+    def _completed(self, status: int) -> None:
+        # parity with the loop path's relay-time callback (_proxy_relay)
+        if self.on_complete is not None:
+            try:
+                self.on_complete(status)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+
+    def _attempt_blocking(self, target) -> tuple:
+        deadline = time.monotonic() + self.timeout_s
+        with socket.create_connection(target, timeout=self.timeout_s) as sock:
+            sock.sendall(self.raw_request)
+            buf = bytearray()
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("upstream attempt timed out")
+                sock.settimeout(remaining)
+                chunk = sock.recv(_READ_CHUNK)
+                if not chunk:
+                    parsed = _parse_http_response(buf, eof=True)
+                    if parsed is None:
+                        raise ConnectionError("upstream closed mid-response")
+                    return parsed
+                buf += chunk
+                parsed = _parse_http_response(buf, eof=False)
+                if parsed is not None:
+                    return parsed
+
+
 SSE_RETRY_MS = 3000
 SSE_PREAMBLE = f"retry: {SSE_RETRY_MS}\n\n".encode("ascii")
 
@@ -243,6 +420,7 @@ class _Conn:
         "sock", "fd", "addr", "rbuf", "wbuf", "state", "keep_alive",
         "last_activity", "waiter", "deadline", "next_poll",
         "sse_streaming", "notify_pending_at", "mask", "close_after_write",
+        "upstream",
     )
 
     def __init__(self, sock: socket.socket, addr):
@@ -261,6 +439,32 @@ class _Conn:
         self.notify_pending_at: Optional[float] = None
         self.mask = 0
         self.close_after_write = False
+        self.upstream: Optional["_UpstreamConn"] = None
+
+
+class _UpstreamConn:
+    """Loop-side state of one in-flight proxied request: the upstream
+    socket currently being tried plus the client connection awaiting
+    the relay. One instance survives failover — ``sock`` is replaced
+    per attempt, ``index`` walks ``upstream.targets``."""
+
+    __slots__ = (
+        "client", "upstream", "index", "sock", "fd", "rbuf", "wbuf",
+        "connected", "deadline", "mask", "last_5xx",
+    )
+
+    def __init__(self, client: _Conn, upstream: Upstream):
+        self.client = client
+        self.upstream = upstream
+        self.index = 0
+        self.sock: Optional[socket.socket] = None
+        self.fd = -1
+        self.rbuf = bytearray()
+        self.wbuf = bytearray()
+        self.connected = False
+        self.deadline: Optional[float] = None
+        self.mask = 0
+        self.last_5xx: Optional[tuple] = None
 
 
 def _raw_response(status_line: str, headers, body: bytes, keep_alive: bool) -> bytes:
@@ -328,6 +532,7 @@ class LoopServer:
         self._commands: collections.deque = collections.deque()
         self._conns: dict[int, _Conn] = {}
         self._parked: set[_Conn] = set()
+        self._upstreams: set[_UpstreamConn] = set()
         self._stopping = False
         self._stop_deadline = 0.0
         self._last_sweep = time.monotonic()
@@ -394,6 +599,15 @@ class LoopServer:
                         self._accept()
                     elif key.data == "wake":
                         self._drain_wake()
+                    elif isinstance(key.data, _UpstreamConn):
+                        ups = key.data
+                        if mask & selectors.EVENT_WRITE:
+                            self._upstream_writable(ups)
+                        if (
+                            ups in self._upstreams
+                            and mask & selectors.EVENT_READ
+                        ):
+                            self._upstream_readable(ups)
                     else:
                         conn = key.data
                         if mask & selectors.EVENT_READ:
@@ -427,6 +641,9 @@ class LoopServer:
                 timeout = min(timeout, max(conn.deadline - now, 0.0))
             if conn.next_poll is not None:
                 timeout = min(timeout, max(conn.next_poll - now, 0.0))
+        for ups in self._upstreams:
+            if ups.deadline is not None:
+                timeout = min(timeout, max(ups.deadline - now, 0.0))
         return timeout
 
     def _drain_wake(self) -> None:
@@ -455,6 +672,10 @@ class LoopServer:
                     self._park(conn, waiter)
                 else:
                     waiter._wake = None
+            elif kind == "proxy":
+                conn, upstream = payload
+                if self._alive(conn):
+                    self._proxy_start(conn, upstream)
             elif kind == "wake":
                 conn = payload
                 if self._alive(conn) and conn.state == _PARKED:
@@ -621,6 +842,12 @@ class LoopServer:
                     iterable.close()
                 self._post(("park", (conn, waiter)))
                 return
+            upstream = environ.get("lo.upstream")
+            if upstream is not None:
+                if hasattr(iterable, "close"):
+                    iterable.close()
+                self._post(("proxy", (conn, upstream)))
+                return
             try:
                 body = b"".join(iterable)
             finally:
@@ -712,6 +939,207 @@ class LoopServer:
         conn.rbuf.clear()
         conn.state = _WRITING
         self._queue_write(conn, raw, close=True)
+
+    # -- upstream proxying -------------------------------------------------
+
+    def _proxy_start(self, conn: _Conn, upstream: Upstream) -> None:
+        if conn.upstream is not None:  # defensive: one proxy per request
+            self._abort_upstream(conn.upstream)
+        ups = _UpstreamConn(conn, upstream)
+        conn.upstream = ups
+        self._upstreams.add(ups)
+        self._proxy_attempt(ups)
+
+    def _proxy_attempt(self, ups: _UpstreamConn) -> None:
+        """Open a non-blocking connection to the current target and
+        register it in the loop's selector; immediate failures advance
+        the index without recursing."""
+        while True:
+            if ups.index >= len(ups.upstream.targets):
+                self._proxy_exhausted(ups)
+                return
+            target = ups.upstream.targets[ups.index]
+            if ups.upstream.on_attempt is not None:
+                try:
+                    ups.upstream.on_attempt(ups.index, target)
+                except Exception:  # noqa: BLE001 — observer must not kill
+                    traceback.print_exc()
+            try:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock.setblocking(False)
+                sock.connect_ex(target)  # EINPROGRESS reports via SO_ERROR
+            except OSError:
+                ups.index += 1
+                continue
+            ups.sock = sock
+            ups.fd = sock.fileno()
+            ups.rbuf = bytearray()
+            ups.wbuf = bytearray(ups.upstream.raw_request)
+            ups.connected = False
+            ups.deadline = time.monotonic() + ups.upstream.timeout_s
+            ups.mask = selectors.EVENT_WRITE
+            try:
+                self._sel.register(sock, ups.mask, ups)
+            except (KeyError, ValueError, OSError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                ups.index += 1
+                continue
+            return
+
+    def _upstream_writable(self, ups: _UpstreamConn) -> None:
+        if ups.sock is None or ups not in self._upstreams:
+            return
+        if not ups.connected:
+            try:
+                error = ups.sock.getsockopt(
+                    socket.SOL_SOCKET, socket.SO_ERROR
+                )
+            except OSError:
+                error = 1
+            if error:
+                self._proxy_retry(ups)
+                return
+            ups.connected = True
+        sent_total = 0
+        failed = False
+        if ups.wbuf:
+            view = memoryview(ups.wbuf)
+            try:
+                while sent_total < len(view):
+                    try:
+                        sent = ups.sock.send(view[sent_total:])
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    except OSError:
+                        failed = True
+                        break
+                    if sent <= 0:
+                        break
+                    sent_total += sent
+            finally:
+                view.release()
+            del ups.wbuf[:sent_total]
+        if failed:
+            self._proxy_retry(ups)
+            return
+        mask = selectors.EVENT_READ
+        if ups.wbuf:
+            mask |= selectors.EVENT_WRITE
+        if mask != ups.mask:
+            ups.mask = mask
+            try:
+                self._sel.modify(ups.sock, mask, ups)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _upstream_readable(self, ups: _UpstreamConn) -> None:
+        if ups.sock is None or not ups.connected:
+            # stale event for a socket a failover just replaced
+            return
+        try:
+            data = ups.sock.recv(_READ_CHUNK)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._proxy_retry(ups)
+            return
+        eof = not data
+        if data:
+            ups.rbuf += data
+        try:
+            parsed = _parse_http_response(ups.rbuf, eof=eof)
+        except ValueError:
+            self._proxy_retry(ups)
+            return
+        if parsed is None:
+            if eof or len(ups.rbuf) > _MAX_BUFFERED_BYTES:
+                self._proxy_retry(ups)  # torn or abusive reply
+            return
+        if parsed[0] >= 500:
+            ups.last_5xx = parsed
+            self._proxy_retry(ups)
+            return
+        self._proxy_relay(ups, parsed)
+
+    def _proxy_retry(self, ups: _UpstreamConn) -> None:
+        self._drop_upstream_socket(ups)
+        ups.index += 1
+        if not self._alive(ups.client):
+            self._abort_upstream(ups)  # client left: nothing to answer
+            return
+        self._proxy_attempt(ups)
+
+    def _proxy_exhausted(self, ups: _UpstreamConn) -> None:
+        if ups.last_5xx is not None:
+            # the real upstream error beats a synthetic 502
+            self._proxy_relay(ups, ups.last_5xx)
+            return
+        payload, status = ups.upstream.on_exhausted()
+        body = json.dumps(payload).encode("utf-8")
+        self._proxy_relay(
+            ups,
+            (
+                status,
+                _http_reasons.get(status, "Unknown"),
+                [("Content-Type", "application/json")],
+                body,
+            ),
+        )
+
+    def _proxy_relay(self, ups: _UpstreamConn, parsed: tuple) -> None:
+        self._drop_upstream_socket(ups)
+        self._upstreams.discard(ups)
+        conn = ups.client
+        if conn.upstream is ups:
+            conn.upstream = None
+        if not self._alive(conn):
+            return
+        status, reason, headers, body = parsed
+        upstream = ups.upstream
+        if upstream.on_complete is not None:
+            try:
+                upstream.on_complete(status)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc()
+        header_list = _relay_headers(headers)
+        if upstream.correlation_id and not any(
+            key.lower() == "x-correlation-id" for key, _ in header_list
+        ):
+            header_list.append(
+                ("X-Correlation-ID", upstream.correlation_id)
+            )
+        raw = _raw_response(
+            f"{status} {reason or _http_reasons.get(status, 'Unknown')}",
+            header_list,
+            body,
+            conn.keep_alive,
+        )
+        conn.state = _WRITING
+        self._queue_write(conn, raw, close=not conn.keep_alive)
+
+    def _drop_upstream_socket(self, ups: _UpstreamConn) -> None:
+        if ups.sock is None:
+            return
+        try:
+            self._sel.unregister(ups.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            ups.sock.close()
+        except OSError:
+            pass
+        ups.sock = None
+        ups.connected = False
+        ups.deadline = None
+
+    def _abort_upstream(self, ups: _UpstreamConn) -> None:
+        self._drop_upstream_socket(ups)
+        self._upstreams.discard(ups)
+        if ups.client is not None and ups.client.upstream is ups:
+            ups.client.upstream = None
 
     # -- waiters -----------------------------------------------------------
 
@@ -831,6 +1259,9 @@ class LoopServer:
             elif conn.next_poll is not None and now >= conn.next_poll:
                 conn.next_poll = now + (waiter.interval_s or 1.0)
                 self._try_resolve(conn)
+        for ups in list(self._upstreams):
+            if ups.deadline is not None and now >= ups.deadline:
+                self._proxy_retry(ups)  # stalled attempt: next target
 
     # -- shutdown ----------------------------------------------------------
 
@@ -874,6 +1305,8 @@ class LoopServer:
         if conn.waiter is not None:
             conn.waiter._wake = None
             conn.waiter = None
+        if conn.upstream is not None:
+            self._abort_upstream(conn.upstream)
         try:
             self._sel.unregister(conn.sock)
         except (KeyError, ValueError, OSError):

@@ -637,6 +637,70 @@ class TestCoalescingManifest:
             load({"window_ms": "2"})
 
 
+class TestFleetManifest:
+    def test_fleet_section_plumbs_env_cluster_wide(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        manifest["fleet"] = {
+            "replicas": 4,
+            "rf": 2,
+            "model_qps": 10,
+            "down_s": 1.5,
+        }
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:  # placement geometry must be cluster-uniform
+            env = plan["env"]
+            assert env["LO_FLEET_REPLICAS"] == "4"
+            assert env["LO_FLEET_RF"] == "2"
+            assert env["LO_FLEET_MODEL_QPS"] == "10"
+            assert env["LO_FLEET_DOWN_S"] == "1.5"
+
+    def test_no_section_means_no_fleet_env(self, tmp_path):
+        cluster = _load_cluster_module()
+        manifest = _manifest()
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(manifest))
+        plans = cluster.machine_plans(cluster.load_manifest(str(path)))
+        for plan in plans:
+            assert "LO_FLEET_REPLICAS" not in plan["env"]
+
+    def test_fleet_validation_rejects_bad_knobs(self, tmp_path):
+        cluster = _load_cluster_module()
+
+        def load(fleet):
+            manifest = _manifest()
+            manifest["fleet"] = fleet
+            path = tmp_path / "m.json"
+            path.write_text(json.dumps(manifest))
+            return cluster.load_manifest(str(path))
+
+        # replicas 1 is the explicit degenerate fleet; qps 0 = quota
+        # off; fractional down window — all valid
+        assert load({"replicas": 1})["fleet"]["replicas"] == 1
+        assert load({"model_qps": 0})["fleet"]["model_qps"] == 0
+        assert load({"down_s": 0.5})["fleet"]["down_s"] == 0.5
+        with pytest.raises(SystemExit):
+            load({"surprise_knob": 1})
+        with pytest.raises(SystemExit):
+            load({"replicas": True})  # bool-is-int trap
+        with pytest.raises(SystemExit):
+            load({"replicas": 0})
+        with pytest.raises(SystemExit):
+            load({"replicas": 2.5})  # strictly integral
+        with pytest.raises(SystemExit):
+            load({"rf": 0})
+        with pytest.raises(SystemExit):
+            load({"rf": "2"})
+        with pytest.raises(SystemExit):
+            load({"model_qps": -1})
+        with pytest.raises(SystemExit):
+            load({"down_s": 0})
+        with pytest.raises(SystemExit):
+            load({"down_s": True})
+
+
 class TestWireManifest:
     def test_wire_section_plumbs_env_cluster_wide(self, tmp_path):
         cluster = _load_cluster_module()

@@ -43,6 +43,12 @@ def test_pip_install_provides_reference_client_surface(tmp_path):
         "assert Model.MODEL_BUILDER_PORT == '5002'\n"
         "assert callable(Model.predict) and callable(Model.list_models)\n"
         "assert callable(Model.sweep)\n"
+        # the fleet lane ships installed: the router-URL probe on the
+        # client and the placement/router modules (stdlib imports only
+        # at module top — jax/werkzeug load lazily)
+        "assert callable(Model._router_base)\n"
+        "import learningorchestra_tpu.serve.fleet as fleet\n"
+        "assert callable(fleet.validate_env)\n"
         # the coalescing stage + batched-fit entry points ship installed
         "import learningorchestra_tpu.sched.coalesce as co\n"
         "assert callable(co.global_coalescer)\n"

@@ -697,6 +697,101 @@ class TestLoadGenerator:
         finally:
             plane.close()
 
+    def test_sessions_close_on_success_and_send_error(self):
+        """Per-client sessions are handed to send and closed in
+        ``finally`` — including when a send raises mid-loop (the leak
+        path: a failed client used to abandon its connection)."""
+        from learningorchestra_tpu.serve.loadgen import run_closed_loop
+
+        class Session:
+            def __init__(self, index):
+                self.index = index
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+
+        sessions = []
+
+        def session_factory(index):
+            session = Session(index)
+            sessions.append(session)
+            return session
+
+        def send(index, session):
+            assert session.index == index
+            if index == 2:
+                raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError, match="boom"):
+            run_closed_loop(
+                send, 4, 3, session_factory=session_factory
+            )
+        assert len(sessions) == 4
+        assert all(session.closed for session in sessions)
+
+        sessions.clear()
+        stats = run_closed_loop(
+            lambda index, session: None,
+            3,
+            2,
+            session_factory=session_factory,
+        )
+        assert stats["requests"] == 6
+        assert all(session.closed for session in sessions)
+
+    def test_session_factory_failure_aborts_barrier(self):
+        """A client dying BEFORE the start barrier must abort it (no
+        deadlock) and surface the root cause, not the collateral
+        BrokenBarrierError the other clients see."""
+        from learningorchestra_tpu.serve.loadgen import run_closed_loop
+
+        opened = []
+
+        class Session:
+            def __init__(self):
+                self.closed = False
+                opened.append(self)
+
+            def close(self):
+                self.closed = True
+
+        def session_factory(index):
+            if index == 1:
+                raise OSError("connect refused")
+            return Session()
+
+        with pytest.raises(OSError, match="connect refused"):
+            run_closed_loop(
+                lambda index, session: None,
+                3,
+                5,
+                session_factory=session_factory,
+            )
+        assert all(session.closed for session in opened)
+
+    def test_http_sender_parameterizes_targets(self):
+        """Client i's session targets targets[i % len(targets)] — one
+        target is router mode, several spread clients across replicas.
+        No hardcoded single target anywhere."""
+        from learningorchestra_tpu.serve.loadgen import (
+            http_predict_sender,
+        )
+
+        targets = ["127.0.0.1:5102", "http://127.0.0.1:5103"]
+        send, session_factory = http_predict_sender(
+            targets, "m", [[1.0]]
+        )
+        assigned = [session_factory(i).target for i in range(4)]
+        assert assigned == [
+            "127.0.0.1:5102",
+            "http://127.0.0.1:5103",
+            "127.0.0.1:5102",
+            "http://127.0.0.1:5103",
+        ]
+        with pytest.raises(ValueError, match="at least one target"):
+            http_predict_sender([], "m", [[1.0]])
+
 
 class TestServeConfig:
     def test_defaults(self, monkeypatch):
